@@ -1,0 +1,35 @@
+# flashy_tpu — a TPU-native research training framework built on JAX/XLA.
+#
+# Provides the capabilities of facebookresearch/flashy (see /root/reference,
+# flashy/__init__.py:9-15 for the reference public surface), re-designed
+# TPU-first: explicit XLA collectives over ICI/DCN instead of
+# torch.distributed/NCCL, pytree checkpoints instead of torch.save, and
+# pjit/shard_map data-parallel step functions instead of DDP.
+"""
+flashy_tpu is a minimal, hackable training framework for TPU pods.
+
+The core abstraction is the :class:`BaseSolver`, which takes care of two
+things — metric logging to multiple backends with custom formatting, and
+checkpointing with automatic tracking of stateful solver attributes — plus
+distributed-training utilities (alternatives to DDP built on XLA
+collectives) and data-loader wrappers that shard per TPU process and
+prefetch host→HBM.
+
+Time is organized in *epochs*: atomic commit units containing named
+*stages* (train, valid, test, generate, ...). At the end of each epoch the
+solver *commits*: metrics are appended to the experiment history and a
+checkpoint is written atomically.
+
+Experiment management (XP folders, signatures, history) is built in via
+the :mod:`flashy_tpu.xp` module — no external launcher required.
+"""
+
+__version__ = "0.1.0"
+
+from . import distrib  # noqa
+from . import adversarial  # noqa
+from .formatter import Formatter  # noqa
+from .logging import ResultLogger, LogProgressBar, bold, setup_logging  # noqa
+from .solver import BaseSolver  # noqa
+from .utils import averager  # noqa
+from .xp import get_xp, main  # noqa

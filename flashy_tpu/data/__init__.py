@@ -1,0 +1,3 @@
+# Data pipeline: per-process sharded loading + host→HBM prefetch.
+# flake8: noqa
+from .loader import DataLoader, ShardedSampler, StridedShard, prefetch_to_device

@@ -1,0 +1,5 @@
+# Experiment logger backends. flake8: noqa
+from .base import ExperimentLogger
+from .localfs import LocalFSLogger
+from .tensorboard import TensorboardLogger
+from .wandb import WandbLogger

@@ -1,0 +1,126 @@
+# TensorBoard backend (soft dependency). Role parity with reference
+# flashy/loggers/tensorboard.py:28-221, fixing its quirks: consistent
+# (prefix, key, ...) media signatures and scalar metrics logged regardless
+# of the media flag.
+"""TensorboardLogger: SummaryWriter-based experiment backend."""
+import logging
+import typing as tp
+
+import numpy as np
+
+from ..distrib import rank_zero_only
+from .base import ExperimentLogger, Prefix
+from . import utils
+
+logger = logging.getLogger(__name__)
+
+try:
+    from torch.utils.tensorboard import SummaryWriter
+    _TENSORBOARD_AVAILABLE = True
+except Exception:  # pragma: no cover - depends on install
+    try:
+        from tensorboardX import SummaryWriter  # type: ignore
+        _TENSORBOARD_AVAILABLE = True
+    except Exception:
+        SummaryWriter = None  # type: ignore
+        _TENSORBOARD_AVAILABLE = False
+
+
+class TensorboardLogger(ExperimentLogger):
+    """Log scalars and media to TensorBoard.
+
+    Soft dependency: when tensorboard is absent, construction warns and
+    every call becomes a no-op, so solvers don't need conditional code.
+    """
+
+    def __init__(self, save_dir: str, with_media_logging: bool = False,
+                 name: str = "tensorboard", **kwargs: tp.Any):
+        self._save_dir = save_dir
+        self._with_media_logging = with_media_logging
+        self._name = name
+        self._writer = None
+        if _TENSORBOARD_AVAILABLE and self._is_writer_rank():
+            self._writer = SummaryWriter(log_dir=save_dir, **kwargs)
+        elif not _TENSORBOARD_AVAILABLE:
+            logger.warning("tensorboard is not installed: TensorboardLogger will no-op.")
+
+    @staticmethod
+    def _is_writer_rank() -> bool:
+        from ..distrib import is_rank_zero
+        return is_rank_zero()
+
+    @rank_zero_only
+    def log_hyperparams(self, params, metrics: tp.Optional[dict] = None) -> None:
+        if self._writer is None:
+            return
+        params = utils.sanitize_params(utils.flatten_dict(utils.convert_params(params)))
+        metrics = dict(metrics or {"hparams_metrics": -1})
+        self._writer.add_hparams(params, metrics)
+        self._writer.flush()
+
+    @rank_zero_only
+    def log_metrics(self, prefix: Prefix, metrics: dict,
+                    step: tp.Optional[int] = None) -> None:
+        if self._writer is None:
+            return
+        named = utils.add_prefix(metrics, prefix, self.group_separator)
+        for key, value in named.items():
+            if isinstance(value, dict):
+                self._writer.add_scalars(key, value, global_step=step)
+            else:
+                self._writer.add_scalar(key, float(np.asarray(value)), global_step=step)
+        self._writer.flush()
+
+    @rank_zero_only
+    def log_audio(self, prefix: Prefix, key: str, audio: tp.Any, sample_rate: int,
+                  step: tp.Optional[int] = None, **kwargs: tp.Any) -> None:
+        if self._writer is None or not self.with_media_logging:
+            return
+        data = utils.to_numpy_media(audio)
+        if data.ndim == 2:
+            data = data.mean(axis=0)  # mix down to mono for the TB widget
+        data = np.clip(data, -1.0, 1.0)
+        tag = utils.join_prefix(prefix, key, self.group_separator)
+        self._writer.add_audio(tag, data[None, :], global_step=step,
+                               sample_rate=int(sample_rate))
+        self._writer.flush()
+
+    @rank_zero_only
+    def log_image(self, prefix: Prefix, key: str, image: tp.Any,
+                  step: tp.Optional[int] = None, **kwargs: tp.Any) -> None:
+        if self._writer is None or not self.with_media_logging:
+            return
+        data = utils.to_numpy_media(image)
+        dataformats = "CHW" if data.ndim == 3 and data.shape[0] in (1, 3, 4) else "HWC"
+        tag = utils.join_prefix(prefix, key, self.group_separator)
+        self._writer.add_image(tag, data, global_step=step, dataformats=dataformats)
+        self._writer.flush()
+
+    @rank_zero_only
+    def log_text(self, prefix: Prefix, key: str, text: str,
+                 step: tp.Optional[int] = None, **kwargs: tp.Any) -> None:
+        if self._writer is None or not self.with_media_logging:
+            return
+        tag = utils.join_prefix(prefix, key, self.group_separator)
+        self._writer.add_text(tag, text, global_step=step)
+        self._writer.flush()
+
+    @property
+    def with_media_logging(self) -> bool:
+        return self._with_media_logging
+
+    @property
+    def save_dir(self) -> tp.Optional[str]:
+        return self._save_dir
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @classmethod
+    def from_xp(cls, with_media_logging: bool = True,
+                name: str = "tensorboard", sub_dir: str = "tensorboard",
+                **kwargs: tp.Any) -> "TensorboardLogger":
+        from ..xp import get_xp
+        save_dir = str(get_xp().folder / sub_dir)
+        return cls(save_dir, with_media_logging=with_media_logging, name=name, **kwargs)

@@ -1,0 +1,122 @@
+# Weights & Biases backend (soft dependency). Role parity with reference
+# flashy/loggers/wandb.py:27-228, fixing its quirks: scalar metrics are
+# always logged (the reference dropped them when media logging was off,
+# wandb.py:110) and media methods use consistent (prefix, key) order.
+"""WandbLogger: Weights & Biases experiment backend."""
+import logging
+from pathlib import Path
+import typing as tp
+
+from ..distrib import rank_zero_only
+from .base import ExperimentLogger, Prefix
+from . import utils
+
+logger = logging.getLogger(__name__)
+
+try:
+    import wandb
+    _WANDB_AVAILABLE = True
+except Exception:  # pragma: no cover - depends on install
+    wandb = None  # type: ignore
+    _WANDB_AVAILABLE = False
+
+
+class WandbLogger(ExperimentLogger):
+    """Log to Weights & Biases.
+
+    The run id is the XP signature, so re-running the same config resumes
+    the same wandb run — the resume marker file (`wandb_flag`) in the XP
+    folder records that a run was started from this experiment.
+    """
+
+    def __init__(self, save_dir: str, with_media_logging: bool = True,
+                 name: str = "wandb", project: tp.Optional[str] = None,
+                 group: tp.Optional[str] = None, run_id: tp.Optional[str] = None,
+                 run_name: tp.Optional[str] = None, **kwargs: tp.Any):
+        self._save_dir = save_dir
+        self._with_media_logging = with_media_logging
+        self._name = name
+        self._run = None
+        if not _WANDB_AVAILABLE:
+            logger.warning("wandb is not installed: WandbLogger will no-op.")
+            return
+        if not self._is_writer_rank():
+            return
+        flag = Path(save_dir) / "wandb_flag"
+        resume = flag.exists()
+        flag.parent.mkdir(parents=True, exist_ok=True)
+        flag.touch()
+        self._run = wandb.init(project=project, group=group, id=run_id,
+                               name=run_name, dir=save_dir,
+                               resume="allow" if resume else None, **kwargs)
+
+    @staticmethod
+    def _is_writer_rank() -> bool:
+        from ..distrib import is_rank_zero
+        return is_rank_zero()
+
+    @rank_zero_only
+    def log_hyperparams(self, params, metrics: tp.Optional[dict] = None) -> None:
+        if self._run is None:
+            return
+        params = utils.sanitize_params(utils.flatten_dict(utils.convert_params(params)))
+        self._run.config.update(params, allow_val_change=True)
+        if metrics:
+            self._run.log(metrics)
+
+    @rank_zero_only
+    def log_metrics(self, prefix: Prefix, metrics: dict,
+                    step: tp.Optional[int] = None) -> None:
+        if self._run is None:
+            return
+        named = utils.add_prefix(utils.sanitize_params(metrics), prefix,
+                                 self.group_separator)
+        self._run.log(named, step=step)
+
+    @rank_zero_only
+    def log_audio(self, prefix: Prefix, key: str, audio: tp.Any, sample_rate: int,
+                  step: tp.Optional[int] = None, **kwargs: tp.Any) -> None:
+        if self._run is None or not self.with_media_logging:
+            return
+        data = utils.to_numpy_media(audio)
+        if data.ndim == 2:
+            data = data.T  # wandb expects [T, C]
+        tag = utils.join_prefix(prefix, key, self.group_separator)
+        self._run.log({tag: wandb.Audio(data, sample_rate=int(sample_rate))}, step=step)
+
+    @rank_zero_only
+    def log_image(self, prefix: Prefix, key: str, image: tp.Any,
+                  step: tp.Optional[int] = None, **kwargs: tp.Any) -> None:
+        if self._run is None or not self.with_media_logging:
+            return
+        data = utils.to_numpy_media(image)
+        tag = utils.join_prefix(prefix, key, self.group_separator)
+        self._run.log({tag: wandb.Image(data)}, step=step)
+
+    @rank_zero_only
+    def log_text(self, prefix: Prefix, key: str, text: str,
+                 step: tp.Optional[int] = None, **kwargs: tp.Any) -> None:
+        if self._run is None or not self.with_media_logging:
+            return
+        tag = utils.join_prefix(prefix, key, self.group_separator)
+        self._run.log({tag: wandb.Html(f"<pre>{text}</pre>")}, step=step)
+
+    @property
+    def with_media_logging(self) -> bool:
+        return self._with_media_logging
+
+    @property
+    def save_dir(self) -> tp.Optional[str]:
+        return self._save_dir
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @classmethod
+    def from_xp(cls, with_media_logging: bool = True, name: str = "wandb",
+                **kwargs: tp.Any) -> "WandbLogger":
+        from ..xp import get_xp
+        xp = get_xp()
+        return cls(str(xp.folder), with_media_logging=with_media_logging,
+                   name=name, run_id=xp.sig, **kwargs)

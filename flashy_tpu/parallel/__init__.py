@@ -1,0 +1,9 @@
+# Parallelism layer: device meshes, data/FSDP/tensor/sequence sharding,
+# and collectives-based building blocks (ring attention). This is the
+# performance path of the framework: where the reference reached for
+# DistributedDataParallel + NCCL (flashy/distrib.py:65-75), flashy_tpu
+# shards arrays over a jax.sharding.Mesh and lets XLA insert and overlap
+# the collectives over ICI/DCN. flake8: noqa
+from .mesh import make_mesh, default_mesh, set_default_mesh, mesh_shape_from_devices
+from .data_parallel import wrap, shard_batch, replicate, fsdp_sharding, shard_params
+from .ring import ring_attention, ring_self_attention

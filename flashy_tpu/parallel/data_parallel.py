@@ -1,0 +1,155 @@
+# The DDP replacement. Reference `flashy.distrib.wrap` returned a
+# DistributedDataParallel module (flashy/distrib.py:65-75); here `wrap`
+# returns the user's *step function* jitted with the batch sharded over
+# the mesh's batch axes and the train state replicated (or FSDP-sharded).
+# XLA's SPMD partitioner then inserts the gradient psum (or
+# reduce-scatter, under FSDP) and the latency-hiding scheduler overlaps
+# it with the backward — the role of DDP's bucketed NCCL all-reduce and
+# of `eager_sync_gradients` (flashy/distrib.py:153-190), done by the
+# compiler instead of by hooks.
+"""Data-parallel / FSDP step wrapping and batch sharding helpers."""
+import typing as tp
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import default_mesh
+
+BATCH_AXES = ("data", "fsdp")
+
+
+def replicate(tree: tp.Any, mesh: tp.Optional[Mesh] = None) -> tp.Any:
+    """Place every leaf fully replicated over the mesh."""
+    mesh = mesh or default_mesh()
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def batch_spec(batch_axes: tp.Sequence[str] = BATCH_AXES) -> P:
+    """PartitionSpec sharding the leading (batch) dim over the batch axes."""
+    return P(tuple(batch_axes))
+
+
+def shard_batch(batch: tp.Any, mesh: tp.Optional[Mesh] = None,
+                batch_axes: tp.Sequence[str] = BATCH_AXES) -> tp.Any:
+    """Shard a host batch (pytree of arrays, leading dim = batch) over the
+    mesh's batch axes.
+
+    Single-process: a plain device_put with the sharded layout.
+    Multi-process: each process contributes its local shard and the
+    result is the *global* array (per-process loaders feed disjoint data,
+    see flashy_tpu.data), so jitted steps see the full global batch.
+    """
+    mesh = mesh or default_mesh()
+    spec = batch_spec(batch_axes)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return multihost_utils.host_local_array_to_global_array(batch, mesh, spec)
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def fsdp_sharding(tree: tp.Any, mesh: tp.Optional[Mesh] = None,
+                  axis: str = "fsdp", min_size: int = 2 ** 16) -> tp.Any:
+    """Per-leaf NamedShardings that split each large parameter over `axis`.
+
+    The largest dimension divisible by the axis size is sharded; small
+    leaves stay replicated (sharding tiny arrays costs more in collective
+    latency than it saves in HBM). With params sharded this way and the
+    batch sharded on ('data','fsdp'), XLA emits the ZeRO-3 pattern:
+    all-gather params into each matmul, reduce-scatter the grads.
+    """
+    mesh = mesh or default_mesh()
+    axis_size = mesh.shape[axis]
+
+    def leaf_sharding(x) -> NamedSharding:
+        shape = np.shape(x)
+        if axis_size > 1 and np.size(x) >= min_size:
+            # Prefer sharding the largest divisible dim.
+            order = sorted(range(len(shape)), key=lambda i: -shape[i])
+            for dim in order:
+                if shape[dim] % axis_size == 0:
+                    spec = [None] * len(shape)
+                    spec[dim] = axis
+                    return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(leaf_sharding, tree)
+
+
+def shard_params(params: tp.Any, mesh: tp.Optional[Mesh] = None,
+                 axis: str = "fsdp", min_size: int = 2 ** 16) -> tp.Any:
+    """Apply `fsdp_sharding` placements to a concrete parameter pytree."""
+    shardings = fsdp_sharding(params, mesh, axis, min_size)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+def wrap(step_fn: tp.Optional[tp.Callable] = None, *,
+         mesh: tp.Optional[Mesh] = None,
+         batch_axes: tp.Sequence[str] = BATCH_AXES,
+         fsdp: bool = False,
+         state_sharding: tp.Any = None,
+         donate_state: bool = True,
+         static_argnums: tp.Union[int, tp.Sequence[int]] = ()) -> tp.Callable:
+    """Make a step function data-parallel over the mesh — the DDP role.
+
+    The step must have signature `step(state, batch, *rest) -> (state, aux)`
+    (or any output pytree; the first output leg is given the same sharding
+    as the input state). `state` is replicated (or FSDP-sharded with
+    `fsdp=True` / an explicit `state_sharding` pytree); `batch` is sharded
+    on its leading dim over `batch_axes`. Because the loss averages over
+    the *global* batch, `jax.grad` inside the step yields gradients that
+    XLA automatically psums across the batch axes — no explicit
+    `sync_gradients` call, no hooks, no buckets.
+
+    Usable as decorator (`@wrap`) or call (`wrap(step, mesh=mesh)`).
+    Feed batches through `shard_batch` (or `flashy_tpu.data` loaders,
+    which do it for you).
+    """
+    if step_fn is None:
+        return lambda fn: wrap(fn, mesh=mesh, batch_axes=batch_axes, fsdp=fsdp,
+                               state_sharding=state_sharding,
+                               donate_state=donate_state,
+                               static_argnums=static_argnums)
+
+    mesh = mesh or default_mesh()
+    data_sharding = NamedSharding(mesh, batch_spec(batch_axes))
+    replicated = NamedSharding(mesh, P())
+
+    def resolve_state_sharding(state):
+        if state_sharding is not None:
+            return state_sharding
+        if fsdp:
+            return fsdp_sharding(state, mesh)
+        return jax.tree_util.tree_map(lambda _: replicated, state)
+
+    compiled_cache: tp.Dict[tp.Any, tp.Callable] = {}
+
+    def wrapped(state, batch, *rest):
+        key = jax.tree_util.tree_structure(state)
+        if key not in compiled_cache:
+            sharding = resolve_state_sharding(state)
+            # `None` legs leave the sharding to the partitioner (prefix
+            # pytrees are allowed in jit shardings).
+            in_shardings = (sharding, data_sharding) + tuple(None for _ in rest)
+            # Shape the out_shardings to the step's actual output
+            # structure: the first leg of a tuple output is the new state
+            # (same sharding as the input state); anything else is left
+            # to the partitioner. A bare (non-tuple) output is treated as
+            # the state itself.
+            out_struct = jax.eval_shape(step_fn, state, batch, *rest)
+            if isinstance(out_struct, tuple) and len(out_struct) >= 1:
+                out_shardings = (sharding,) + (None,) * (len(out_struct) - 1)
+            else:
+                out_shardings = sharding
+            compiled_cache[key] = jax.jit(
+                step_fn,
+                in_shardings=in_shardings,
+                out_shardings=out_shardings,
+                donate_argnums=(0,) if donate_state else (),
+                static_argnums=static_argnums)
+        return compiled_cache[key](state, batch, *rest)
+
+    wrapped.mesh = mesh  # type: ignore[attr-defined]
+    return wrapped

@@ -1,0 +1,75 @@
+# Device mesh conventions. One global Mesh with named axes is the single
+# source of truth for every parallelism dimension:
+#
+#   'data'   — batch (pure data parallel; gradients psum over it)
+#   'fsdp'   — batch + parameter sharding (ZeRO-ish; XLA all-gathers
+#              params into the matmuls, reduce-scatters the grads)
+#   'tensor' — intra-layer model parallelism (megatron-style splits)
+#   'seq'    — sequence/context parallelism (ring attention)
+#
+# Axes of size 1 cost nothing, so solvers can always write sharding rules
+# against the full 4-axis mesh and scale any subset up later.
+"""Mesh construction and the process-global default mesh."""
+import math
+import typing as tp
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "fsdp", "tensor", "seq")
+
+_default_mesh: tp.Optional[Mesh] = None
+
+
+def mesh_shape_from_devices(n_devices: int,
+                            tensor: int = 1, seq: int = 1,
+                            fsdp: int = 1) -> tp.Dict[str, int]:
+    """Fill the 'data' axis with whatever devices the others don't use."""
+    used = tensor * seq * fsdp
+    if n_devices % used:
+        raise ValueError(f"{n_devices} devices not divisible by tensor*seq*fsdp={used}")
+    return {"data": n_devices // used, "fsdp": fsdp, "tensor": tensor, "seq": seq}
+
+
+def make_mesh(shape: tp.Optional[tp.Mapping[str, int]] = None,
+              devices: tp.Optional[tp.Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh over the given devices (default: all global devices).
+
+    `shape` maps axis name -> size; missing axes get size 1, and a single
+    missing axis size may be -1 (inferred). Default: everything on 'data'.
+
+    Axis order in the device array is (data, fsdp, tensor, seq) — the
+    innermost axes (tensor, seq) change fastest, so on a real pod slice
+    they land on physically adjacent chips where ICI bandwidth is highest,
+    which is where the latency-critical tensor/sequence collectives run.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    shape = dict(shape or {})
+    sizes = {axis: int(shape.get(axis, 1)) for axis in AXES}
+    unknown = [axis for axis in shape if axis not in AXES]
+    if unknown:
+        raise ValueError(f"Unknown mesh axes {unknown}; valid: {AXES}")
+    inferred = [axis for axis, size in sizes.items() if size == -1]
+    if len(inferred) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    if inferred:
+        known = math.prod(size for size in sizes.values() if size != -1)
+        sizes[inferred[0]] = len(devices) // known
+    if math.prod(sizes.values()) != len(devices):
+        raise ValueError(f"Mesh shape {sizes} does not cover {len(devices)} devices")
+    grid = np.array(devices).reshape([sizes[axis] for axis in AXES])
+    return Mesh(grid, AXES)
+
+
+def set_default_mesh(mesh: tp.Optional[Mesh]) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def default_mesh() -> Mesh:
+    """The process-global mesh; lazily a pure data-parallel one."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh({"data": -1})
+    return _default_mesh

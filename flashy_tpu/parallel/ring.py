@@ -1,0 +1,107 @@
+# Ring attention: exact attention over sequences sharded across the
+# mesh's 'seq' axis. Long-context support the reference does not have
+# (SURVEY §5: absent there), built TPU-first: each device holds one
+# sequence block of Q/K/V; K/V blocks rotate around the ring via
+# `lax.ppermute` over ICI while each device accumulates its Q block's
+# attention with the online-softmax (flash attention) recurrence, so the
+# full T×T score matrix never materializes and memory stays O(T_local).
+#
+# Communication pattern follows the ring-attention construction of Liu &
+# Abbeel (blockwise parallel transformers); one K/V block is always in
+# flight, overlapping the ppermute with the block computation.
+"""Sequence-parallel exact attention via K/V ring rotation."""
+import functools
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_scores(q: jax.Array, k: jax.Array, scale: float) -> jax.Array:
+    # q: [B, Tq, H, D], k: [B, Tk, H, D] -> [B, H, Tq, Tk]
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "seq", causal: bool = False) -> jax.Array:
+    """Attention over a sequence sharded on `axis_name`.
+
+    Must be called inside a `shard_map` (or pmap) context where
+    `axis_name` is bound. Arguments are the *local* blocks:
+
+        q, k, v: [batch, t_local, heads, head_dim]
+
+    Returns the local output block [batch, t_local, heads, head_dim] of
+    exact (optionally causal) softmax attention over the *global*
+    sequence. Positions are global: block b covers
+    [b * t_local, (b+1) * t_local).
+    """
+    n_blocks = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    batch, t_local, heads, head_dim = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, dtype=jnp.float32))
+
+    q_pos = my_index * t_local + jnp.arange(t_local)
+
+    def step(carry, step_index):
+        out_acc, row_max, row_sum, k_blk, v_blk = carry
+        k_owner = (my_index - step_index) % n_blocks
+        scores = _block_scores(q, k_blk, scale)  # [B, H, Tq, Tk] f32
+        if causal:
+            k_pos = k_owner * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        blk_max = scores.max(axis=-1)  # [B, H, Tq]
+        new_max = jnp.maximum(row_max, blk_max)
+        # Online softmax rescale of the running accumulator.
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max[..., None])
+        new_sum = row_sum * correction + probs.sum(axis=-1)
+        blk_out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_blk.astype(jnp.float32))
+        new_out = out_acc * correction.transpose(0, 2, 1)[..., None] + blk_out
+        # Rotate K/V one hop around the ring; XLA overlaps this ICI
+        # transfer with the next block's compute.
+        perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (new_out, new_max, new_sum, k_next, v_next), None
+
+    out0 = jnp.zeros((batch, t_local, heads, head_dim), dtype=jnp.float32)
+    max0 = jnp.full((batch, heads, t_local), NEG_INF, dtype=jnp.float32)
+    sum0 = jnp.zeros((batch, heads, t_local), dtype=jnp.float32)
+    # The accumulators start device-invariant but become device-varying
+    # once q enters the recurrence; scan requires matching "varying"
+    # types between carry in and out, so mark them varying up front.
+    varying_axes = jax.typeof(q).vma
+    if varying_axes:
+        axes = tuple(varying_axes)
+        out0, max0, sum0 = (jax.lax.pcast(x, axes, to="varying")
+                            for x in (out0, max0, sum0))
+    (out, _, denom, _, _), _ = jax.lax.scan(
+        step, (out0, max0, sum0, k.astype(jnp.float32), v.astype(jnp.float32)),
+        jnp.arange(n_blocks))
+    denom = jnp.maximum(denom, 1e-30)  # fully-masked rows divide safely
+    out = out / denom.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        mesh: tp.Optional[Mesh] = None, axis: str = "seq",
+                        causal: bool = False,
+                        batch_axes: tp.Sequence[str] = ("data", "fsdp")) -> jax.Array:
+    """shard_map entry point: global [B, T, H, D] arrays, T sharded on `axis`.
+
+    Shards the batch over `batch_axes` and the sequence over `axis`, runs
+    `ring_attention` per device. Use inside a jitted step whose arrays
+    already live on the mesh (the specs below just tell shard_map how to
+    slice them).
+    """
+    from .mesh import default_mesh
+    mesh = mesh or default_mesh()
+    spec = P(tuple(batch_axes), axis, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis, causal=causal)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)

@@ -1,0 +1,102 @@
+# Checkpoint content assembly. Behavior parity with reference
+# flashy/state.py:24-88 (StateDictSource protocol, AttributeWrapper,
+# WriteOnlyWrapper, StateManager). Deliberately framework-free: values can
+# be anything serializable — python objects, numpy arrays, JAX pytrees
+# (optax states, flax params) — the serialization layer
+# (flashy_tpu.checkpoint) handles device arrays.
+"""Automatic tracking of stateful solver attributes.
+
+`StateManager` maps a name to a `StateDictSource`. `AttributeWrapper`
+turns *any* attribute of an object into such a source: objects already
+implementing the `state_dict`/`load_state_dict` protocol delegate to it;
+lists and dicts are restored in place; everything else (including JAX
+pytrees, which are immutable values) is restored by plain attribute
+assignment.
+"""
+import typing as tp
+
+StateDict = tp.Any
+
+
+@tp.runtime_checkable
+class StateDictSource(tp.Protocol):
+    """Anything with the idiomatic `state_dict`/`load_state_dict` pair."""
+
+    def state_dict(self) -> StateDict:
+        ...
+
+    def load_state_dict(self, state: StateDict) -> None:
+        ...
+
+
+class AttributeWrapper:
+    """Expose an arbitrary attribute of `owner` as a StateDictSource.
+
+    Restore dispatch (reference flashy/state.py:39-49): protocol match →
+    in-place `load_state_dict`; list → slice assign; dict → clear+update;
+    anything else → `setattr`. JAX pytrees (tuples of arrays, optax
+    states, flax FrozenDicts) are immutable values and take the `setattr`
+    path, which is exactly right: the attribute is rebound to the restored
+    tree.
+    """
+
+    def __init__(self, owner: tp.Any, name: str):
+        self.owner = owner
+        self.name = name
+
+    def state_dict(self) -> StateDict:
+        attr = getattr(self.owner, self.name)
+        if isinstance(attr, StateDictSource):
+            return attr.state_dict()
+        return attr
+
+    def load_state_dict(self, state: StateDict) -> None:
+        attr = getattr(self.owner, self.name)
+        if isinstance(attr, StateDictSource):
+            attr.load_state_dict(state)
+        elif isinstance(attr, list):
+            attr[:] = state
+        elif isinstance(attr, dict):
+            attr.clear()
+            attr.update(state)
+        else:
+            setattr(self.owner, self.name, state)
+
+
+class WriteOnlyWrapper(StateDictSource):
+    """Saved into checkpoints for forensics, never restored.
+
+    Used for the experiment config and signature (reference
+    flashy/solver.py:35): you want them recorded next to the weights, but
+    restoring them would clobber the live run's config.
+    """
+
+    def __init__(self, source: StateDictSource):
+        self.source = source
+
+    def state_dict(self) -> StateDict:
+        return self.source.state_dict()
+
+    def load_state_dict(self, state: StateDict) -> None:
+        return None
+
+
+class StateManager(StateDictSource):
+    """Registry of named StateDictSources; itself a StateDictSource."""
+
+    def __init__(self):
+        self.sources: tp.Dict[str, StateDictSource] = {}
+
+    def register(self, name: str, source: StateDictSource, write_only: bool = False) -> None:
+        if name in self.sources:
+            raise ValueError(f"{name} already present in sources.")
+        if write_only:
+            source = WriteOnlyWrapper(source)
+        self.sources[name] = source
+
+    def state_dict(self) -> StateDict:
+        return {name: source.state_dict() for name, source in self.sources.items()}
+
+    def load_state_dict(self, state: StateDict) -> None:
+        for name, sub_state in state.items():
+            self.sources[name].load_state_dict(sub_state)

@@ -1,0 +1,113 @@
+# Core utilities for flashy_tpu.
+#
+# Behavior parity with reference flashy/utils.py:19-69 (averager,
+# write_and_rename, readonly), re-designed for JAX: metric values may be
+# jax scalars (device arrays) and are converted on the host; `readonly`
+# is provided for API compatibility but the idiomatic JAX spelling is
+# `jax.lax.stop_gradient`, which `freeze` applies over a pytree.
+"""Various utilities: metric averaging, atomic file writes, pytree helpers."""
+from collections import defaultdict
+from contextlib import contextmanager
+from pathlib import Path
+import os
+import typing as tp
+
+import jax
+import numpy as np
+
+AnyPath = tp.Union[Path, str]
+
+
+def _scalar(value: tp.Any) -> float:
+    """Convert a metric value (python number, numpy or jax scalar) to float.
+
+    Device→host transfer happens here, once per metric, outside of jit.
+    """
+    if isinstance(value, (jax.Array, np.ndarray)):
+        return float(np.asarray(value))
+    return float(value)
+
+
+def averager(beta: float = 1.0) -> tp.Callable[..., tp.Dict[str, float]]:
+    """Exponential Moving Average callback over dicts of metrics.
+
+    Returns a function ``update(metrics, weight=1)`` that folds the given
+    metrics into the running average and returns the averaged dict. With
+    ``beta=1`` this is a plain (weighted) mean — the common case for
+    per-epoch metric averaging. Mirrors reference flashy/utils.py:19-37.
+
+    Values can be python floats, numpy scalars or jax scalars; jax values
+    are pulled to the host (so call this outside of jit, typically on the
+    output of a jitted step function).
+    """
+    num: tp.Dict[str, float] = defaultdict(float)
+    den: tp.Dict[str, float] = defaultdict(float)
+
+    def _update(metrics: tp.Dict[str, tp.Any], weight: float = 1.0) -> tp.Dict[str, float]:
+        for key, value in metrics.items():
+            num[key] = num[key] * beta + weight * _scalar(value)
+            den[key] = den[key] * beta + weight
+        return {key: value / den[key] for key, value in num.items()}
+
+    return _update
+
+
+@contextmanager
+def write_and_rename(path: AnyPath, mode: str = "wb", suffix: str = ".tmp", pid: bool = False):
+    """Write to a temporary file, then atomically rename over `path`.
+
+    Renaming is atomic on POSIX filesystems, so a process killed mid-write
+    (e.g. TPU pod preemption) can never leave a truncated checkpoint at the
+    final path. Mirrors reference flashy/utils.py:40-54.
+    """
+    tmp_path = str(path) + suffix
+    if pid:
+        tmp_path += f".{os.getpid()}"
+    with open(tmp_path, mode) as f:
+        yield f
+    os.rename(tmp_path, path)
+
+
+def freeze(tree: tp.Any) -> tp.Any:
+    """Return a copy of the pytree with gradients blocked on every leaf.
+
+    The JAX equivalent of temporarily flipping ``requires_grad`` off
+    (reference flashy/utils.py:57-69): apply the adversary with
+    ``freeze(params)`` and its parameters receive no gradient from the
+    enclosing `jax.grad`.
+    """
+    return jax.tree_util.tree_map(jax.lax.stop_gradient, tree)
+
+
+# `readonly` is the reference's name for the same concept; in JAX there is
+# no mutable requires_grad flag, so we expose it as a trivial alias used as
+# `model.apply(readonly(params), x)`.
+readonly = freeze
+
+
+def to_numpy(tree: tp.Any) -> tp.Any:
+    """Convert every array leaf of a pytree to a host numpy array.
+
+    Used when assembling checkpoints: device arrays are gathered to host
+    memory so serialization never holds HBM references. Globally-sharded
+    arrays (multi-host, not fully addressable locally) are all-gathered —
+    a COLLECTIVE: every process must call this together, even if only
+    rank zero writes the result to disk.
+    """
+
+    def _leaf(x):
+        if isinstance(x, jax.Array):
+            if not x.is_fully_addressable:
+                from jax.experimental import multihost_utils
+                return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+            return np.asarray(jax.device_get(x))
+        return x
+
+    return jax.tree_util.tree_map(_leaf, tree)
+
+
+def tree_bytes(tree: tp.Any) -> int:
+    """Total size in bytes of all array leaves of a pytree."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return sum(x.size * x.dtype.itemsize for x in leaves
+               if isinstance(x, (jax.Array, np.ndarray)))

@@ -1,0 +1,36 @@
+# Test harness: run everything on a virtual 8-device CPU mesh so device
+# level parallelism (sharding, collectives, ring attention) is exercised
+# without TPU hardware — the strategy SURVEY.md §4 prescribes (the
+# reference's analogue was gloo-on-localhost, tests/test_distrib.py:22).
+#
+# NOTE: the axon sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon; the env var is therefore too late, but the backend
+# is not initialized yet, so flipping the config before any device query
+# works.
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from flashy_tpu.xp import temporary_xp  # noqa: E402
+
+
+@pytest.fixture()
+def xp():
+    """A throwaway active XP in a temp dir."""
+    with temporary_xp({"dummy": 1}) as active:
+        yield active
+
+
+@pytest.fixture()
+def mesh8():
+    """2x2x2x1 mesh (data x fsdp x tensor x seq) over the 8 CPU devices."""
+    from flashy_tpu.parallel import make_mesh
+    return make_mesh({"data": 2, "fsdp": 2, "tensor": 2, "seq": 1})

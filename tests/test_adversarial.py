@@ -1,0 +1,99 @@
+# Tests for AdversarialLoss: discriminator training direction, generator
+# loss gradient isolation (stop_gradient replaces `readonly`), and the
+# embedded-optimizer checkpoint round trip
+# (reference flashy/adversarial.py:53-89 semantics).
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from flashy_tpu.adversarial import AdversarialLoss, bce_with_logits
+from flashy_tpu.checkpoint import load_state, save_state
+
+
+def linear_apply(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def make_adv(lr=0.1):
+    params = {"w": jnp.zeros((3, 1)), "b": jnp.zeros(1)}
+    return AdversarialLoss(linear_apply, params, optax.sgd(lr))
+
+
+def test_train_adv_updates_discriminator():
+    adv = make_adv()
+    fake = jnp.ones((8, 3)) * 2.0
+    real = -jnp.ones((8, 3)) * 2.0
+    first = float(adv.train_adv(fake, real))
+    for _ in range(50):
+        last = float(adv.train_adv(fake, real))
+    assert last < first  # D learns to separate them
+    # D now assigns higher fake-logit to fake than to real
+    logit_fake = float(linear_apply(adv.params, fake).mean())
+    logit_real = float(linear_apply(adv.params, real).mean())
+    assert logit_fake > logit_real
+
+
+def test_generator_loss_direction():
+    adv = make_adv()
+    for _ in range(100):
+        adv.train_adv(jnp.ones((8, 3)), -jnp.ones((8, 3)))
+    # a fake that looks like 'real' (negative) fools D better -> lower loss
+    fooled = float(adv(-jnp.ones((4, 3))))
+    obvious = float(adv(jnp.ones((4, 3))))
+    assert fooled < obvious
+
+
+def test_gen_loss_shields_discriminator_params():
+    adv = make_adv()
+
+    def gen_side(fake_source):
+        fake = fake_source * jnp.ones((4, 3))
+        return adv.gen_loss(adv.params, fake)
+
+    grad_wrt_source = jax.grad(gen_side)(1.0)
+    # gradient flows to the generator input...
+    assert np.isfinite(grad_wrt_source)
+
+    def d_side(params_d):
+        return adv.gen_loss(params_d, jnp.ones((4, 3)))
+
+    grads_d = jax.grad(d_side)(adv.params)
+    # ...but NOT to the discriminator (stop_gradient shield)
+    np.testing.assert_allclose(np.asarray(grads_d["w"]), 0.0)
+    np.testing.assert_allclose(np.asarray(grads_d["b"]), 0.0)
+
+
+def test_train_adv_does_not_touch_generator_inputs():
+    # fake comes in detached (stop_gradient), so D training cannot leak
+    # gradients back — structurally guaranteed; check numerics anyway.
+    adv = make_adv()
+
+    def through(fake_scale):
+        fake = fake_scale * jnp.ones((4, 3))
+        logit = linear_apply(adv.params, jax.lax.stop_gradient(fake))
+        return bce_with_logits(logit, jnp.ones_like(logit))
+
+    assert float(jax.grad(through)(2.0)) == 0.0
+
+
+def test_state_dict_embeds_optimizer(tmp_path):
+    adv = make_adv()
+    adv.train_adv(jnp.ones((4, 3)), -jnp.ones((4, 3)))
+    state = adv.state_dict()
+    assert "optimizer" in state and "params" in state
+
+    save_state(state, tmp_path / "adv.fsy")
+    restored = load_state(tmp_path / "adv.fsy")
+
+    fresh = make_adv()
+    fresh.load_state_dict(restored)
+    np.testing.assert_allclose(np.asarray(fresh.params["w"]),
+                               np.asarray(adv.params["w"]))
+    # optimizer state grafted back into proper optax structure
+    before = [np.asarray(x) for x in jax.tree_util.tree_leaves(adv.opt_state)]
+    after = [np.asarray(x) for x in jax.tree_util.tree_leaves(fresh.opt_state)]
+    for a, b in zip(before, after):
+        np.testing.assert_allclose(a, b)
+    # and training continues from there without error
+    fresh.train_adv(jnp.ones((4, 3)), -jnp.ones((4, 3)))

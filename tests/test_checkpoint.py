@@ -1,0 +1,76 @@
+# Tests for checkpoint IO: pickle path, atomicity, torch interop
+# round-trip (the BASELINE.json north-star requirement), and optax state
+# survival.
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from flashy_tpu.checkpoint import (from_torch_state_dict, load_state, save_state,
+                                   to_torch_state_dict)
+
+
+def test_save_load_roundtrip(tmp_path):
+    state = {
+        "params": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+        "history": [{"train": {"loss": 1.0}}],
+        "epoch": 3,
+    }
+    path = tmp_path / "ckpt.fsy"
+    save_state(state, path)
+    loaded = load_state(path)
+    np.testing.assert_allclose(loaded["params"]["w"], np.arange(6).reshape(2, 3))
+    assert isinstance(loaded["params"]["w"], np.ndarray)  # host arrays
+    assert loaded["history"] == state["history"]
+    assert loaded["epoch"] == 3
+
+
+def test_no_partial_file_on_crash(tmp_path):
+    path = tmp_path / "ckpt.fsy"
+    save_state({"a": 1}, path)
+
+    class Boom:
+        def __reduce__(self):
+            raise RuntimeError("not picklable")
+
+    with pytest.raises(RuntimeError):
+        save_state({"bad": Boom()}, path)
+    # original checkpoint intact
+    assert load_state(path) == {"a": 1}
+
+
+def test_optax_state_roundtrip(tmp_path):
+    params = {"w": jnp.ones(3)}
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    grads = {"w": jnp.full(3, 0.1)}
+    _, opt_state = opt.update(grads, opt_state, params)
+
+    save_state({"opt": opt_state}, tmp_path / "o.fsy")
+    restored = load_state(tmp_path / "o.fsy")["opt"]
+    orig_leaves = [np.asarray(x) for x in
+                   __import__("jax").tree_util.tree_leaves(opt_state)]
+    new_leaves = [np.asarray(x) for x in
+                  __import__("jax").tree_util.tree_leaves(restored)]
+    assert len(orig_leaves) == len(new_leaves)
+    for a, b in zip(orig_leaves, new_leaves):
+        np.testing.assert_allclose(a, b)
+
+
+def test_torch_interop_roundtrip():
+    torch = pytest.importorskip("torch")
+    tree = {"layer": {"kernel": jnp.ones((2, 2)), "bias": jnp.zeros(2)}, "step": 5}
+    flat = to_torch_state_dict(tree)
+    assert isinstance(flat["layer.kernel"], torch.Tensor)
+    assert flat["step"] == 5
+    back = from_torch_state_dict(flat)
+    np.testing.assert_allclose(back["layer"]["kernel"], np.ones((2, 2)))
+    np.testing.assert_allclose(back["layer"]["bias"], np.zeros(2))
+
+
+def test_from_torch_accepts_torch_module_state():
+    torch = pytest.importorskip("torch")
+    module = torch.nn.Linear(4, 2)
+    tree = from_torch_state_dict(module.state_dict())
+    assert tree["weight"].shape == (2, 4)
+    assert tree["bias"].shape == (2,)

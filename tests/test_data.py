@@ -1,0 +1,105 @@
+# Tests for the data pipeline: shard semantics (equal train shards, no
+# eval replication — reference flashy/distrib.py:227-243), epoch
+# reshuffling, collation, threaded workers, and device prefetch.
+import numpy as np
+
+from flashy_tpu.data import DataLoader, ShardedSampler, StridedShard, prefetch_to_device
+from flashy_tpu.data.loader import default_collate
+from flashy_tpu.parallel import make_mesh
+
+
+class SquareDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"x": np.full((3,), i, dtype=np.float32), "y": np.int64(i)}
+
+
+def test_strided_shard_partitions_without_replication():
+    data = SquareDataset(10)
+    shards = [StridedShard(data, r, 3) for r in range(3)]
+    seen = sorted(int(s[i]["y"]) for s in shards for i in range(len(s)))
+    assert seen == list(range(10))  # exact partition
+    assert [len(s) for s in shards] == [4, 3, 3]
+
+
+def test_sharded_sampler_equal_sizes_cover_all():
+    sampler_a = ShardedSampler(10, 0, 4, shuffle=True, seed=1)
+    sampler_b = ShardedSampler(10, 1, 4, shuffle=True, seed=1)
+    assert len(sampler_a) == len(sampler_b) == 3  # padded equal shards
+    all_indices = []
+    for rank in range(4):
+        sampler = ShardedSampler(10, rank, 4, shuffle=True, seed=1)
+        all_indices += list(sampler)
+    assert set(all_indices) == set(range(10))  # covers everything
+    assert len(all_indices) == 12  # 2 wrapped duplicates
+
+
+def test_sampler_epoch_reshuffle():
+    sampler = ShardedSampler(20, 0, 1, shuffle=True, seed=0)
+    sampler.set_epoch(0)
+    first = list(sampler)
+    sampler.set_epoch(1)
+    second = list(sampler)
+    assert first != second
+    assert sorted(first) == sorted(second)
+
+
+def test_default_collate_nested():
+    samples = [{"x": np.ones(2), "pair": (np.zeros(1), np.ones(1))} for _ in range(3)]
+    batch = default_collate(samples)
+    assert batch["x"].shape == (3, 2)
+    assert batch["pair"][0].shape == (3, 1)
+
+
+def test_loader_train_drops_last_and_batches():
+    loader = DataLoader(SquareDataset(10), batch_size=4, shuffle=True, seed=0)
+    batches = list(loader)
+    assert len(batches) == len(loader) == 2  # 10 -> 2 full batches
+    assert batches[0]["x"].shape == (4, 3)
+
+
+def test_loader_eval_keeps_all():
+    loader = DataLoader(SquareDataset(10), batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == len(loader) == 3
+    assert batches[-1]["x"].shape == (2, 3)
+    ys = np.concatenate([b["y"] for b in batches])
+    np.testing.assert_array_equal(ys, np.arange(10))
+
+
+def test_loader_sharded_eval():
+    loaders = [DataLoader(SquareDataset(10), batch_size=2, shuffle=False,
+                          num_shards=2, shard_index=r) for r in range(2)]
+    seen = sorted(int(y) for loader in loaders for b in loader for y in b["y"])
+    assert seen == list(range(10))
+
+
+def test_loader_threaded_workers_same_result():
+    inline = list(DataLoader(SquareDataset(8), batch_size=2, num_workers=0))
+    threaded = list(DataLoader(SquareDataset(8), batch_size=2, num_workers=4))
+    for a, b in zip(inline, threaded):
+        np.testing.assert_array_equal(a["x"], b["x"])
+
+
+def test_prefetch_to_device_yields_global_sharded():
+    mesh = make_mesh({"data": -1})
+    loader = DataLoader(SquareDataset(16), batch_size=8, shuffle=False)
+    out = list(prefetch_to_device(loader, size=2, mesh=mesh, batch_axes=("data",)))
+    assert len(out) == 2
+    assert out[0]["x"].shape == (8, 3)
+    total = np.concatenate([np.asarray(b["y"]) for b in out])
+    np.testing.assert_array_equal(np.sort(total), np.arange(16))
+
+
+def test_sharded_sampler_tiny_dataset_no_empty_shards():
+    # dataset smaller than shard count: every shard still non-empty and
+    # equal-size (empty shards would hang per-step collectives)
+    samplers = [ShardedSampler(3, r, 8, shuffle=True, seed=0) for r in range(8)]
+    lengths = [len(list(s)) for s in samplers]
+    assert lengths == [1] * 8
+    assert all(0 <= i < 3 for s in samplers for i in s)

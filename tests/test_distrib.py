@@ -1,0 +1,79 @@
+# Tests for flashy_tpu.distrib. Single-process behavior (every helper is
+# identity / no-op at world_size 1, the reference's core invariant,
+# flashy/distrib.py:41-47) is tested here; true multi-process collective
+# equivalence is tested in test_distrib_multiproc.py by spawning
+# localhost workers (the reference's 8-process gloo strategy,
+# tests/test_distrib.py:82-98).
+import numpy as np
+import pytest
+
+from flashy_tpu import distrib
+
+
+def test_single_process_identities():
+    assert distrib.rank() == 0
+    assert distrib.world_size() == 1
+    assert distrib.is_rank_zero()
+    assert not distrib.is_distributed()
+
+
+def test_rank_zero_only_runs():
+    calls = []
+
+    @distrib.rank_zero_only
+    def fn(x):
+        calls.append(x)
+        return x
+
+    assert fn(5) == 5
+    assert calls == [5]
+
+
+def test_average_metrics_identity():
+    metrics = {"loss": 1.0, "acc": 0.5}
+    assert distrib.average_metrics(metrics, count=3) == metrics
+
+
+def test_tree_helpers_identity():
+    tree = {"w": np.ones(3), "n": np.array([2], dtype=np.int64)}
+    out = distrib.average_tensors(tree)
+    assert out is tree  # no copy when single process
+    out = distrib.broadcast_tensors(tree)
+    assert out is tree
+    out = distrib.sync_gradients(tree)
+    assert out is tree
+
+
+def test_sync_model_identity():
+    params = {"w": np.ones(2)}
+    stats = {"mean": np.zeros(2)}
+    assert distrib.sync_model(params) is params
+    new_params, new_stats = distrib.sync_model(params, stats)
+    assert new_params is params and new_stats is stats
+
+
+def test_broadcast_object_identity():
+    obj = {"a": [1, 2, 3]}
+    assert distrib.broadcast_object(obj) is obj
+
+
+def test_barrier_noop():
+    distrib.barrier()  # must not hang or raise
+
+
+def test_all_reduce_identity():
+    x = np.array([1.0, 2.0])
+    assert distrib.all_reduce(x) is x
+
+
+def test_init_single_process_noop():
+    distrib.init()
+    assert distrib.world_size() == 1
+
+
+def test_loader_delegates(tmp_path):
+    data = [np.full((2,), i, dtype=np.float32) for i in range(10)]
+    loader = distrib.loader(data, batch_size=2, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 5
+    assert batches[0].shape == (2, 2)

@@ -1,0 +1,57 @@
+# Unit tests for Formatter — whitelist/blacklist semantics per reference
+# flashy/formatter.py:22-33 docstring contract.
+from flashy_tpu.formatter import Formatter
+
+
+def test_default_format():
+    formatter = Formatter()
+    assert formatter({"loss": 0.123456}) == {"loss": "0.123"}
+
+
+def test_explicit_formats_first_match_wins():
+    formatter = Formatter({"acc*": ".1%", "*": ".2f"})
+    out = formatter({"acc_top1": 0.987, "loss": 1.0})
+    assert out["acc_top1"] == "98.7%"
+    assert out["loss"] == "1.00"
+
+
+def test_blacklist():
+    formatter = Formatter(exclude_keys=["debug_*"])
+    out = formatter({"debug_x": 1.0, "loss": 2.0})
+    assert out == {"loss": "2.000"}
+
+
+def test_whitelist():
+    formatter = Formatter(include_keys=["loss"])
+    out = formatter({"loss": 2.0, "other": 3.0})
+    assert out == {"loss": "2.000"}
+
+
+def test_exclude_then_include_back():
+    formatter = Formatter(exclude_keys=["*"], include_keys=["loss"])
+    out = formatter({"loss": 2.0, "other": 3.0})
+    assert out == {"loss": "2.000"}
+
+
+def test_include_formatted_implicit():
+    # Formatted keys are implicitly whitelisted out of a full blacklist.
+    formatter = Formatter({"acc": ".1%"}, exclude_keys=["*"])
+    out = formatter({"acc": 0.5, "hidden": 1.0})
+    assert out == {"acc": "50.0%"}
+
+
+def test_include_formatted_off():
+    formatter = Formatter({"acc": ".1%"}, exclude_keys=["*"], include_formatted=False)
+    assert formatter({"acc": 0.5}) == {}
+
+
+def test_get_relevant_metrics_no_filters():
+    formatter = Formatter()
+    metrics = {"a": 1, "b": 2}
+    assert formatter.get_relevant_metrics(metrics) == metrics
+
+
+def test_int_and_str_values():
+    formatter = Formatter({"epoch": "d", "name": "s"})
+    out = formatter({"epoch": 7, "name": "run"})
+    assert out == {"epoch": "7", "name": "run"}

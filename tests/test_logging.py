@@ -1,0 +1,104 @@
+# Tests for the logging stack: setup, progress bar cadence/speed text,
+# result fan-out, and the LocalFS backend media writers.
+import logging
+import wave
+
+import numpy as np
+
+from flashy_tpu.formatter import Formatter
+from flashy_tpu.logging import LogProgressBar, ResultLogger, bold, colorize, setup_logging
+from flashy_tpu.loggers.localfs import LocalFSLogger
+from flashy_tpu.loggers import utils as logger_utils
+
+
+def test_colorize_bold():
+    assert colorize("x", "31") == "\033[31mx\033[0m"
+    assert bold("y") == "\033[1my\033[0m"
+
+
+def test_setup_logging_writes_file(xp):
+    setup_logging(folder=xp.folder)
+    logging.getLogger("flashy_tpu.test").info("hello file")
+    for handler in logging.getLogger().handlers:
+        handler.flush()
+    log_file = xp.folder / "solver.log.0"
+    assert log_file.exists()
+    assert "hello file" in log_file.read_text()
+    logging.getLogger().handlers.clear()
+
+
+def test_log_progress_bar_cadence(caplog):
+    logger = logging.getLogger("flashy_tpu.test.progress")
+    bar = LogProgressBar(logger, range(10), updates=5, name="Train")
+    with caplog.at_level(logging.INFO, logger=logger.name):
+        for index in bar:
+            bar.update(loss=float(index))
+    messages = [r.message for r in caplog.records]
+    # cadence = 10//5 = 2; logging delayed by one iteration
+    assert len(messages) == 4
+    assert all("Train" in m for m in messages)
+    # metrics from the previous update() call are included, formatted .3f
+    assert "loss" in messages[0]
+
+
+def test_log_progress_bar_unsized(caplog):
+    logger = logging.getLogger("flashy_tpu.test.progress2")
+    bar = LogProgressBar(logger, iter(range(8)), total=8, updates=4)
+    with caplog.at_level(logging.INFO, logger=logger.name):
+        for _ in bar:
+            pass
+    assert len(caplog.records) == 3
+
+
+def test_speed_buckets():
+    logger = logging.getLogger("x")
+    bar = LogProgressBar(logger, range(1))
+    assert bar._speed_text(2.0) == "2.00 it/sec"
+    assert bar._speed_text(0.05) == "20.0 sec/it"
+    assert bar._speed_text(1e-5) == "oo sec/it"
+    bar_it = LogProgressBar(logger, range(1), time_per_it=True)
+    assert bar_it._speed_text(0.5) == "2.00 sec/it"
+    assert bar_it._speed_text(10.0) == "100.0 ms/it"
+
+
+def test_result_logger_summary_and_media(xp, caplog):
+    logger = logging.getLogger("flashy_tpu.test.results")
+    results = ResultLogger(logger)
+    with caplog.at_level(logging.INFO, logger=logger.name):
+        results.log_metrics("train", {"loss": 0.5}, step=3,
+                            formatter=Formatter({"loss": ".2f"}))
+    assert any("Train Summary" in r.message and "Epoch 3" in r.message
+               and "loss=0.50" in r.message for r in caplog.records)
+
+    results.log_image("valid", "sample", np.zeros((3, 4, 4)), step=1)
+    out = xp.folder / "outputs" / "valid_1" / "sample.png"
+    assert out.exists()
+
+    results.log_text("valid", "note", "hello", step=1)
+    assert (xp.folder / "outputs" / "valid_1" / "note.txt").read_text() == "hello"
+
+
+def test_localfs_audio_roundtrip(xp):
+    backend = LocalFSLogger.from_xp()
+    audio = np.sin(np.linspace(0, 100, 1600))[None, :]  # [C, T]
+    backend.log_audio("gen", "tone", audio, 16000, step=2)
+    path = xp.folder / "outputs" / "gen_2" / "tone.wav"
+    with wave.open(str(path)) as w:
+        assert w.getnchannels() == 1
+        assert w.getframerate() == 16000
+        assert w.getnframes() == 1600
+
+
+def test_localfs_hyperparams(xp):
+    backend = LocalFSLogger.from_xp()
+    backend.log_hyperparams({"optim": {"lr": 0.1}, "fn": print})
+    data = (xp.folder / "outputs" / "hyperparams.json").read_text()
+    assert "optim/lr" in data
+
+
+def test_logger_utils():
+    assert logger_utils.join_prefix(["a", "b"], "c") == "a/b/c"
+    assert logger_utils.add_prefix({"x": 1}, "s") == {"s/x": 1}
+    assert logger_utils.flatten_dict({"a": {"b": 1}}) == {"a/b": 1}
+    out = logger_utils.sanitize_params({"v": np.float32(1.5), "obj": object()})
+    assert out["v"] == 1.5 and isinstance(out["obj"], str)

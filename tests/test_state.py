@@ -1,0 +1,108 @@
+# Unit tests for the state registry — filling the reference's empty
+# tests/test_state.py stub with real coverage of the dispatch rules
+# (reference flashy/state.py:39-49).
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flashy_tpu.state import AttributeWrapper, StateManager, WriteOnlyWrapper
+
+
+class WithProtocol:
+    def __init__(self):
+        self.value = 0
+
+    def state_dict(self):
+        return {"value": self.value}
+
+    def load_state_dict(self, state):
+        self.value = state["value"]
+
+
+class Holder:
+    pass
+
+
+def test_attribute_wrapper_plain_value():
+    holder = Holder()
+    holder.x = 42
+    wrapper = AttributeWrapper(holder, "x")
+    assert wrapper.state_dict() == 42
+    wrapper.load_state_dict(7)
+    assert holder.x == 7
+
+
+def test_attribute_wrapper_list_in_place():
+    holder = Holder()
+    holder.items = [1, 2]
+    alias = holder.items
+    AttributeWrapper(holder, "items").load_state_dict([3, 4, 5])
+    assert alias == [3, 4, 5]  # restored in place, alias sees it
+
+
+def test_attribute_wrapper_dict_in_place():
+    holder = Holder()
+    holder.table = {"a": 1}
+    alias = holder.table
+    AttributeWrapper(holder, "table").load_state_dict({"b": 2})
+    assert alias == {"b": 2}
+
+
+def test_attribute_wrapper_protocol_delegation():
+    holder = Holder()
+    holder.module = WithProtocol()
+    wrapper = AttributeWrapper(holder, "module")
+    holder.module.value = 5
+    state = wrapper.state_dict()
+    holder.module.value = 0
+    wrapper.load_state_dict(state)
+    assert holder.module.value == 5
+
+
+def test_attribute_wrapper_pytree_rebind():
+    holder = Holder()
+    holder.params = {"w": jnp.ones(3)}
+    # dict branch: restored in place via clear+update
+    AttributeWrapper(holder, "params").load_state_dict({"w": np.zeros(3)})
+    np.testing.assert_allclose(holder.params["w"], 0)
+
+
+def test_write_only_wrapper():
+    holder = Holder()
+    holder.cfg = {"lr": 0.1}
+    wrapper = WriteOnlyWrapper(AttributeWrapper(holder, "cfg"))
+    assert wrapper.state_dict() == {"lr": 0.1}
+    wrapper.load_state_dict({"lr": 99.0})
+    assert holder.cfg == {"lr": 0.1}  # never restored
+
+
+def test_state_manager_roundtrip():
+    manager = StateManager()
+    holder = Holder()
+    holder.a = 1
+    holder.b = [1, 2]
+    manager.register("a", AttributeWrapper(holder, "a"))
+    manager.register("b", AttributeWrapper(holder, "b"))
+    # state_dict returns live references (as in the reference); the
+    # serialization layer snapshots them — simulate that boundary here.
+    import copy
+    state = copy.deepcopy(manager.state_dict())
+    holder.a = 0
+    holder.b[:] = []
+    manager.load_state_dict(state)
+    assert holder.a == 1 and holder.b == [1, 2]
+
+
+def test_state_manager_duplicate_raises():
+    manager = StateManager()
+    holder = Holder()
+    holder.a = 1
+    manager.register("a", AttributeWrapper(holder, "a"))
+    with pytest.raises(ValueError):
+        manager.register("a", AttributeWrapper(holder, "a"))
+
+
+def test_state_manager_unknown_key_raises():
+    manager = StateManager()
+    with pytest.raises(KeyError):
+        manager.load_state_dict({"ghost": 1})
