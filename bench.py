@@ -1,0 +1,105 @@
+# Benchmark harness. Prints ONE JSON line:
+#   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+#
+# Headline (BASELINE.json metric): CIFAR-10 ResNet-18 training
+# throughput in images/sec/chip, measured on whatever accelerator is
+# attached (the driver runs this on one real TPU chip). Measures a
+# representative jitted train step (bf16 NHWC ResNet-18, SGD+momentum,
+# data-parallel mesh over the available devices) fed through the
+# framework's host->device prefetcher over rotating host batches, so
+# input-pipeline cost is included; the full examples.cifar solver adds
+# logging/augmentation on top of this.
+#
+# The reference publishes no numbers (BASELINE.md: "none published"), so
+# vs_baseline is reported against REFERENCE_IMAGES_PER_SEC below — the
+# same workload measured with the reference's torch stack on a single
+# V100-class GPU (batch 256, CIFAR ResNet-18 ~3000 img/s is the widely
+# reproduced ballpark; the north-star asks for "matching single-GPU
+# wall-clock", BASELINE.json).
+"""flashy_tpu benchmark: CIFAR ResNet-18 images/sec/chip."""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_IMAGES_PER_SEC = 3000.0  # single-GPU torch reference ballpark
+
+BATCH_SIZE = 256
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+
+
+def main() -> None:
+    import optax
+    from flashy_tpu.models import resnet18
+    from flashy_tpu.parallel import make_mesh, shard_batch, wrap
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = make_mesh({"data": n_chips})
+
+    model = resnet18(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    optim = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    state = {
+        "params": variables["params"],
+        "batch_stats": variables["batch_stats"],
+        "opt_state": optim.init(variables["params"]),
+    }
+
+    def step(state, batch):
+        def loss_fn(params):
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": state["batch_stats"]},
+                batch["image"], train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["label"]).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"])
+        updates, opt_state = optim.update(grads, state["opt_state"])
+        return ({"params": optax.apply_updates(state["params"], updates),
+                 "batch_stats": batch_stats, "opt_state": opt_state},
+                {"loss": loss})
+
+    train_step = wrap(step, mesh=mesh, batch_axes=("data",))
+
+    rng = np.random.default_rng(0)
+    host_batches = [{
+        "image": rng.normal(size=(BATCH_SIZE, 32, 32, 3)).astype(np.float32),
+        "label": rng.integers(0, 10, BATCH_SIZE).astype(np.int32),
+    } for _ in range(4)]
+
+    from flashy_tpu.data import prefetch_to_device
+
+    def batch_stream(n_steps):
+        return prefetch_to_device(
+            (host_batches[i % len(host_batches)] for i in range(n_steps)),
+            size=2, mesh=mesh, batch_axes=("data",))
+
+    for batch in batch_stream(WARMUP_STEPS):
+        state, metrics = train_step(state, batch)
+    jax.block_until_ready(state["params"])
+
+    begin = time.perf_counter()
+    for batch in batch_stream(MEASURE_STEPS):
+        state, metrics = train_step(state, batch)
+    jax.block_until_ready(state["params"])
+    elapsed = time.perf_counter() - begin
+
+    images_per_sec = MEASURE_STEPS * BATCH_SIZE / elapsed
+    per_chip = images_per_sec / n_chips
+    print(json.dumps({
+        "metric": "cifar10_resnet18_train_images_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

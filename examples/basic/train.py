@@ -1,0 +1,67 @@
+# The smallest complete solver — the role of reference
+# examples/basic/train.py:12-55 (nn.Linear(32, 1) + Adam, stateful
+# model/optim/best_state, tensorboard, checkpoint every 2 epochs),
+# expressed the JAX way: params/opt_state pytrees registered as stateful,
+# one jitted step function.
+"""Minimal flashy_tpu example: linear regression on random data."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import flashy_tpu
+from flashy_tpu.models import MLP
+
+
+class Solver(flashy_tpu.BaseSolver):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.model = MLP([1])  # Linear(32 -> 1)
+        self.params = self.model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32)))
+        self.optim = optax.adam(cfg.lr)
+        self.opt_state = self.optim.init(self.params)
+        self.best_state = {}
+        self.register_stateful("params", "opt_state", "best_state")
+        self.init_tensorboard()
+
+        @jax.jit
+        def step(params, opt_state, x, y):
+            def loss_fn(p):
+                return jnp.mean((self.model.apply(p, x) - y) ** 2)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.optim.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+        self._step = step
+
+    def train(self):
+        average = flashy_tpu.averager()
+        rng = np.random.default_rng(self.epoch)
+        metrics = {}
+        for _ in range(10):
+            x = jnp.asarray(rng.normal(size=(self.cfg.batch_size, 32)).astype(np.float32))
+            y = x.sum(axis=1, keepdims=True) * 0.1
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, x, y)
+            metrics = average({"loss": loss})
+        return metrics
+
+    def run(self):
+        self.restore()
+        for epoch in range(self.epoch, self.cfg.epochs + 1):
+            metrics = self.run_stage("train", self.train)
+            if not self.best_state or metrics["loss"] < self.best_state.get("loss", 1e9):
+                self.best_state = {"loss": metrics["loss"],
+                                   "params": jax.device_get(self.params)}
+            self.commit(save_checkpoint=epoch % 2 == 0)
+
+
+@flashy_tpu.main(config_path="config")
+def main(cfg):
+    flashy_tpu.setup_logging()
+    flashy_tpu.distrib.init()
+    Solver(cfg).run()
+
+
+if __name__ == "__main__":
+    main()

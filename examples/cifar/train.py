@@ -1,0 +1,47 @@
+# CIFAR entry point — the role of reference examples/cifar/train.py:37-65
+# (loader construction via flashy.distrib.loader, solver assembly, and
+# the `get_solver_from_sig` notebook re-attach helper).
+"""Train a ResNet on CIFAR-10 with flashy_tpu."""
+import flashy_tpu
+from flashy_tpu import distrib
+
+from .data import CifarDataset, load_cifar10
+from .solver import Solver
+
+
+def get_solver(cfg):
+    x_train, y_train, x_test, y_test, is_real = load_cifar10()
+    train_set = CifarDataset(x_train, y_train, augment=True)
+    valid_set = CifarDataset(x_test, y_test)
+    loaders = {
+        # shuffle=True -> equal per-process shards (training); eval uses
+        # the strided no-replication shard.
+        "train": distrib.loader(train_set, batch_size=cfg.batch_size,
+                                shuffle=True, num_workers=4),
+        "valid": distrib.loader(valid_set, batch_size=cfg.batch_size,
+                                num_workers=4),
+    }
+    solver = Solver(cfg, loaders)
+    solver.logger.info("CIFAR-10 data: %s", "real" if is_real else "synthetic")
+    return solver
+
+
+@flashy_tpu.main(config_path="config")
+def main(cfg):
+    flashy_tpu.setup_logging()
+    distrib.init()
+    solver = get_solver(cfg)
+    solver.run()
+
+
+def get_solver_from_sig(sig: str):
+    """Re-attach to a finished/running XP from a notebook."""
+    xp = main.get_xp_from_sig(sig)
+    with xp.enter():
+        solver = get_solver(xp.cfg)
+        solver.restore()
+    return solver
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+# Transformer-LM solver — the flagship workload (the AudioCraft-style
+# "downstream Flashy user" of BASELINE.json configs[4]). Demonstrates
+# the full parallelism surface on one mesh: data parallelism, FSDP
+# parameter sharding, megatron-style tensor parallelism and ring
+# attention sequence parallelism, all expressed as shardings on a single
+# jitted train step (placement propagates from the arrays; XLA inserts
+# the collectives).
+"""LM solver: sharded decoder-only language model training."""
+from dataclasses import replace as dataclasses_replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import flashy_tpu
+from flashy_tpu.models import TransformerConfig, TransformerLM, transformer_shardings
+from flashy_tpu.parallel import make_mesh, shard_batch
+
+
+def synthetic_token_stream(vocab_size: int, seed: int = 0):
+    """Deterministic Markov-ish token generator: next-token structure a
+    model can actually learn, so loss curves are meaningful without a
+    real corpus (zero-egress environments)."""
+    rng = np.random.default_rng(seed)
+    mixing = rng.integers(1, vocab_size - 1, size=257)
+
+    def batch(batch_size: int, seq_len: int, step: int) -> np.ndarray:
+        gen = np.random.default_rng(seed * 1_000_003 + step)
+        tokens = np.empty((batch_size, seq_len), np.int64)
+        tokens[:, 0] = gen.integers(0, vocab_size, batch_size)
+        noise = gen.random((batch_size, seq_len)) < 0.15
+        jumps = gen.integers(0, vocab_size, (batch_size, seq_len))
+        for t in range(1, seq_len):
+            follow = (tokens[:, t - 1] * 31 + mixing[tokens[:, t - 1] % 257]) % vocab_size
+            tokens[:, t] = np.where(noise[:, t], jumps[:, t], follow)
+        return tokens.astype(np.int32)
+
+    return batch
+
+
+class LMSolver(flashy_tpu.BaseSolver):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        model_cfg = TransformerConfig(
+            vocab_size=cfg.model.vocab_size, dim=cfg.model.dim,
+            num_layers=cfg.model.num_layers, num_heads=cfg.model.num_heads,
+            mlp_ratio=cfg.model.mlp_ratio, attention=cfg.model.attention,
+            remat=cfg.model.get("remat", False))
+        self.mesh = make_mesh({k: v for k, v in cfg.mesh.items()})
+        self.model = TransformerLM(model_cfg, mesh=self.mesh)
+
+        # Params are identical across attention implementations, so init
+        # through the dense twin: cheap, shape-unconstrained, no
+        # collectives at init time.
+        init_model = TransformerLM(
+            dataclasses_replace(model_cfg, attention="dense"))
+        tokens0 = jnp.zeros((1, min(cfg.seq_len, 128)), jnp.int32)
+        variables = init_model.init(jax.random.PRNGKey(0), tokens0)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            transformer_shardings(variables),
+            is_leaf=lambda x: isinstance(x, P))
+        params = jax.device_put(variables, shardings)
+
+        total_steps = max(cfg.epochs * cfg.steps_per_epoch, 2)
+        warmup = min(cfg.warmup_steps, total_steps // 2)  # short-run safe
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.lr, warmup, total_steps)
+        self.optim = optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.adamw(schedule, weight_decay=cfg.weight_decay))
+        # jit the optimizer init so its state inherits the parameter
+        # shardings through SPMD propagation (mu/nu land exactly where
+        # their parameters live — FSDP'd optimizer state for free).
+        opt_state = jax.jit(self.optim.init)(params)
+        self.state = {"params": params, "opt_state": opt_state,
+                      "step": jnp.zeros((), jnp.int32)}
+        # Remember every leaf's sharding so a restored (host numpy) state
+        # can be placed back onto the mesh exactly as it was.
+        self._state_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding, self.state)
+        self.register_stateful("state")
+
+        self._stream = synthetic_token_stream(cfg.model.vocab_size)
+
+        model, optim = self.model, self.optim
+
+        def train_step(state, tokens):
+            def loss_fn(variables):
+                logits = model.apply(variables, tokens)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], tokens[:, 1:]).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            updates, opt_state = optim.update(grads, state["opt_state"],
+                                              state["params"])
+            params = optax.apply_updates(state["params"], updates)
+            return ({"params": params, "opt_state": opt_state,
+                     "step": state["step"] + 1},
+                    {"loss": loss, "grad_norm": optax.global_norm(grads)})
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+
+    def get_formatter(self, stage_name):
+        return flashy_tpu.Formatter({"loss": ".4f", "ppl": ".1f",
+                                     "grad_norm": ".2f", "tokens_per_sec": ".0f"})
+
+    def batch_at(self, step: int) -> jax.Array:
+        host = self._stream(self.cfg.batch_size, self.cfg.seq_len, step)
+        return shard_batch(jnp.asarray(host), self.mesh,
+                           batch_axes=("data", "fsdp"))
+
+    def train(self):
+        import time
+        average = flashy_tpu.averager()
+        steps = range(self.cfg.steps_per_epoch)
+        progress = self.log_progress("train", steps, updates=5)
+        metrics = {}
+        begin = time.time()
+        tokens_seen = 0
+        for index in progress:
+            global_step = (self.epoch - 1) * self.cfg.steps_per_epoch + index
+            self.state, step_metrics = self._train_step(
+                self.state, self.batch_at(global_step))
+            metrics = average(step_metrics)
+            tokens_seen += self.cfg.batch_size * self.cfg.seq_len
+            progress.update(**metrics)
+        jax.block_until_ready(self.state["params"])
+        metrics["ppl"] = float(np.exp(min(metrics["loss"], 20.0)))
+        metrics["tokens_per_sec"] = tokens_seen / (time.time() - begin)
+        return metrics
+
+    def run(self):
+        restored = self.restore()
+        if restored:
+            self.state = jax.tree_util.tree_map(
+                jax.device_put, self.state, self._state_shardings)
+        self.logger.info("Restored: %s; starting at epoch %d", restored, self.epoch)
+        for epoch in range(self.epoch, self.cfg.epochs + 1):
+            self.run_stage("train", self.train)
+            self.commit()
+
+
+@flashy_tpu.main(config_path="config")
+def main(cfg):
+    flashy_tpu.setup_logging()
+    flashy_tpu.distrib.init()
+    LMSolver(cfg).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+# Model zoo: the reference's example models (MLP for the basic example,
+# ResNet for cifar — examples/cifar/train.py:43 used torchvision
+# resnet18) plus the Transformer LM flagship for the AudioCraft-style
+# downstream workload (BASELINE.json configs[4]). flake8: noqa
+from .mlp import MLP
+from .resnet import ResNet, resnet18, resnet34, resnet50
+from .transformer import TransformerLM, TransformerConfig, transformer_shardings

@@ -1,0 +1,31 @@
+# Simple MLP — the model of the reference's smallest example and test
+# fixture (examples/basic/train.py:16 used nn.Linear(32, 1);
+# tests/dummy/train.py used dim-4 MLPs).
+"""MLP: dense layers with configurable activation."""
+import typing as tp
+
+import flax.linen as nn
+import jax
+
+
+class MLP(nn.Module):
+    """Multi-layer perceptron.
+
+    Args:
+        features: output size of each layer; the last entry is the
+            network's output dimension. A single-entry list is a plain
+            linear layer.
+        activation: nonlinearity between layers (not applied after the
+            final layer).
+    """
+
+    features: tp.Sequence[int]
+    activation: tp.Callable[[jax.Array], jax.Array] = nn.relu
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i, size in enumerate(self.features):
+            x = nn.Dense(size, name=f"layers_{i}")(x)
+            if i < len(self.features) - 1:
+                x = self.activation(x)
+        return x

@@ -1,0 +1,121 @@
+# ResNet family for the cifar example and benchmarks — the role
+# torchvision's resnet18 plays in the reference
+# (examples/cifar/train.py:43). Written TPU-first: NHWC layout (the TPU
+# conv-native layout), bf16-friendly compute dtype, and a `small_inputs`
+# mode replacing the ImageNet 7x7/stride-2 stem with a 3x3 stem for
+# 32x32 CIFAR images (standard CIFAR-ResNet practice).
+"""ResNet-18/34/50 in flax, NHWC, with BatchNorm batch_stats."""
+import typing as tp
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = tp.Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 (self.strides, self.strides), name="proj")(residual)
+            residual = self.norm(name="proj_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 (self.strides, self.strides), name="proj")(residual)
+            residual = self.norm(name="proj_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet over NHWC images.
+
+    Args:
+        stage_sizes: blocks per stage, e.g. (2, 2, 2, 2) for ResNet-18.
+        block: BasicBlock or BottleneckBlock.
+        num_classes: classifier output size.
+        num_filters: stem width.
+        small_inputs: CIFAR-style 3x3 stem without max-pool (for 32x32
+            inputs); False gives the ImageNet 7x7/stride-2 stem.
+        dtype: compute dtype — bfloat16 keeps the MXU fed on TPU while
+            params stay float32.
+    """
+
+    stage_sizes: tp.Sequence[int]
+    block: ModuleDef
+    num_classes: int = 10
+    num_filters: int = 64
+    small_inputs: bool = True
+    dtype: tp.Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       kernel_init=nn.initializers.kaiming_normal())
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = conv(self.num_filters, (3, 3), name="stem")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="stem")(x)
+        x = norm(name="stem_norm")(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for stage, size in enumerate(self.stage_sizes):
+            for index in range(size):
+                strides = 2 if stage > 0 and index == 0 else 1
+                x = self.block(self.num_filters * 2 ** stage, conv=conv,
+                               norm=norm, strides=strides)(x)
+
+        x = x.mean(axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(num_classes: int = 10, **kwargs: tp.Any) -> ResNet:
+    return ResNet((2, 2, 2, 2), BasicBlock, num_classes=num_classes, **kwargs)
+
+
+def resnet34(num_classes: int = 10, **kwargs: tp.Any) -> ResNet:
+    return ResNet((3, 4, 6, 3), BasicBlock, num_classes=num_classes, **kwargs)
+
+
+def resnet50(num_classes: int = 10, **kwargs: tp.Any) -> ResNet:
+    return ResNet((3, 4, 6, 3), BottleneckBlock, num_classes=num_classes, **kwargs)

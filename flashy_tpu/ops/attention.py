@@ -1,0 +1,197 @@
+# Attention ops. The reference has no attention (it is model-agnostic,
+# SURVEY §5 long-context: absent); flashy_tpu ships it because the
+# north-star workload (Transformer LM solver, BASELINE.json configs[4])
+# needs a TPU-efficient attention path:
+#
+#  * `dot_product_attention` — plain XLA implementation; correct
+#    everywhere, O(T^2) memory. XLA already fuses the softmax chain.
+#  * `flash_attention` — pallas TPU kernel: tiles Q/K/V blocks through
+#    VMEM with the online-softmax recurrence so the TxT score matrix
+#    never hits HBM. Forward is the pallas kernel; backward is a
+#    custom-vjp recompute in XLA (O(T^2) memory — use sequence
+#    parallelism via flashy_tpu.parallel.ring_attention for sequences
+#    where that matters).
+#
+# Array convention: [batch, time, heads, head_dim] (flax-style).
+"""Attention: XLA reference implementation + pallas flash kernel."""
+import functools
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          causal: bool = False,
+                          mask: tp.Optional[jax.Array] = None) -> jax.Array:
+    """Plain attention over [B, T, H, D] arrays; scores in f32."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        causal_mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        scores = jnp.where(causal_mask[None, None], scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# pallas flash attention (TPU)
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  offset: int):
+    """One (batch*head, q-block, k-block) grid step.
+
+    The TPU grid iterates the last dimension fastest, so for a fixed
+    q-block the k-blocks arrive sequentially and the VMEM scratch
+    (running max / normalizer / accumulator) carries the online-softmax
+    state across them. Output is written on the final k-block.
+
+    `offset = t_k - t_q` aligns causal masking bottom-right (query i
+    attends keys j <= i + offset), matching `dot_product_attention`'s
+    tril(k=t_k-t_q) — the self-attention case has offset 0.
+    """
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qi = pl.program_id(1)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)          # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)          # [block_k, D]
+        v = v_ref[0].astype(jnp.float32)          # [block_k, D]
+        scores = jax.lax.dot_general(             # [block_q, block_k] on MXU
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            scores = jnp.where(q_pos + offset >= k_pos, scores, NEG_INF)
+
+        m_prev = m_scr[:, 0]                       # [block_q]
+        block_max = scores.max(axis=-1)
+        m_new = jnp.maximum(m_prev, block_max)
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new[:, None])   # [block_q, block_k]
+        l_new = l_scr[:, 0] * alpha + probs.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+            probs, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new[:, None]
+        l_scr[:] = l_new[:, None]
+
+    if causal:
+        # Fully-future blocks contribute nothing; skip their MXU work
+        # entirely (roughly halves causal attention FLOPs).
+        visible = ki * block_k <= qi * block_q + block_q - 1 + offset
+        pl.when(visible)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+try:  # pallas import is cheap but keep the module importable everywhere
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_AVAILABLE = True
+except Exception:  # pragma: no cover
+    _PALLAS_AVAILABLE = False
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
+                   block_q: int, block_k: int, interpret: bool) -> jax.Array:
+    batch, t_q, heads, dim = q.shape
+    t_k = k.shape[1]
+    scale = 1.0 / np.sqrt(dim)
+    # Fold batch and heads into the leading grid axis: [B*H, T, D].
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(batch * heads, x.shape[1], dim)
+    qf, kf, vf = fold(q), fold(k), fold(v)
+
+    grid = (batch * heads, t_q // block_q, t_k // block_k)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               offset=t_k - t_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, t_q, dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),    # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),    # running normalizer
+            pltpu.VMEM((block_q, dim), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(batch, heads, t_q, dim).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, grad_out):
+    # Recompute-based backward through the XLA reference implementation:
+    # identical math, O(T^2) memory. For long sequences shard T over the
+    # mesh instead (parallel.ring_attention).
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: dot_product_attention(q, k, v, causal=causal),
+                     q, k, v)
+    return vjp(grad_out)
+
+
+if _PALLAS_AVAILABLE:
+    _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, *, block_q: int = 256,
+                    block_k: int = 256,
+                    interpret: tp.Optional[bool] = None) -> jax.Array:
+    """Flash attention over [B, T, H, D]; pallas on TPU, XLA elsewhere.
+
+    Falls back to `dot_product_attention` when pallas cannot run (non-TPU
+    backend without interpret mode) or when T is not divisible by the
+    block sizes. Block sizes are clamped to the sequence length.
+    """
+    t_q, t_k = q.shape[1], k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    if not _PALLAS_AVAILABLE or t_q % block_q or t_k % block_k:
+        return dot_product_attention(q, k, v, causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

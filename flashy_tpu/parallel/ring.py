@@ -101,7 +101,27 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     from .mesh import default_mesh
     mesh = mesh or default_mesh()
-    spec = P(tuple(batch_axes), axis, None, None)
+    # Shard the batch over the largest prefix of batch_axes it divides
+    # (a probe forward with a tiny batch — e.g. model.init — would
+    # otherwise be rejected by shard_map). Falling short of the full
+    # product means redundant compute, so make it loud.
+    use_batch_axes = []
+    ways = 1
+    for name in batch_axes:
+        if q.shape[0] % (ways * mesh.shape[name]) == 0:
+            use_batch_axes.append(name)
+            ways *= mesh.shape[name]
+    full_ways = 1
+    for name in batch_axes:
+        full_ways *= mesh.shape[name]
+    if ways != full_ways and q.shape[0] > 1:
+        import logging
+        logging.getLogger(__name__).warning(
+            "ring_self_attention: batch %d not divisible by mesh axes %s "
+            "(%d ways); sharding over %s only — redundant compute on the "
+            "remaining axes.", q.shape[0], tuple(batch_axes), full_ways,
+            tuple(use_batch_axes))
+    spec = P(tuple(use_batch_axes) if use_batch_axes else None, axis, None, None)
     fn = functools.partial(ring_attention, axis_name=axis, causal=causal)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)

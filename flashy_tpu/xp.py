@@ -314,6 +314,14 @@ class _EntryPoint:
         return get_xp_from_sig(sig, root=self.dir)
 
     def __call__(self, argv: tp.Optional[tp.Sequence[str]] = None):
+        # Platform pinning via env (e.g. FLASHY_TPU_PLATFORM=cpu for
+        # localhost multi-process tests). Must happen before any device
+        # query; a plain JAX_PLATFORMS env var can be overridden by site
+        # configuration, the config update cannot.
+        platform = os.environ.get("FLASHY_TPU_PLATFORM")
+        if platform:
+            import jax
+            jax.config.update("jax_platforms", platform)
         argv = list(sys.argv[1:] if argv is None else argv)
         cfg, flags = self._resolve(argv)
         xp = create_xp(cfg, root=self.dir, argv=argv)

@@ -1,0 +1,122 @@
+# Miniature end-to-end fixture: teacher-student regression + GAN — the
+# role of reference tests/dummy/train.py:40-119 (two tiny MLPs, an
+# AdversarialLoss, broadcast at init, a `stop_at` knob simulating
+# preemption for the resume test, and a whitelist Formatter).
+"""Dummy training project used by the integration tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import flashy_tpu
+from flashy_tpu import distrib
+from flashy_tpu.adversarial import AdversarialLoss
+from flashy_tpu.models import MLP
+
+
+class NoiseDataset:
+    def __init__(self, n, dim, seed=0):
+        rng = np.random.default_rng(seed)
+        self.data = rng.normal(size=(n, dim)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, index):
+        return self.data[index]
+
+
+class Solver(flashy_tpu.BaseSolver):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        dim = cfg.dim
+        key = jax.random.PRNGKey(42)
+        k_teacher, k_model, k_adv = jax.random.split(key, 3)
+
+        self.teacher_model = MLP([dim, dim])
+        self.teacher = self.teacher_model.init(k_teacher, jnp.zeros((1, dim)))
+        self.student_model = MLP([dim, dim])
+        student_params = distrib.broadcast_model(
+            self.student_model.init(k_model, jnp.zeros((1, dim))))
+        self.optim = optax.adam(cfg.lr)
+        self.state = {"params": student_params,
+                      "opt_state": self.optim.init(student_params)}
+
+        disc = MLP([dim, 1])
+        self.adv = AdversarialLoss(
+            disc.apply, disc.init(k_adv, jnp.zeros((1, dim))),
+            optax.adam(cfg.lr))
+
+        self.register_stateful("teacher", "state", "adv")
+
+        self.loader = distrib.loader(
+            NoiseDataset(cfg.num_samples, dim), batch_size=cfg.batch_size,
+            shuffle=True)
+
+        student_model, teacher_model, optim, adv = \
+            self.student_model, self.teacher_model, self.optim, self.adv
+
+        def gen_step(state, adv_params, teacher, noise):
+            def loss_fn(params):
+                fake = student_model.apply(params, noise)
+                target = teacher_model.apply(teacher, noise)
+                mse = jnp.mean((fake - target) ** 2)
+                gen = adv.gen_loss(adv_params, fake)
+                return mse + 0.1 * gen, (mse, gen)
+
+            (loss, (mse, gen)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state["params"])
+            updates, opt_state = optim.update(grads, state["opt_state"])
+            return ({"params": optax.apply_updates(state["params"], updates),
+                     "opt_state": opt_state},
+                    {"loss": loss, "mse": mse, "adv_gen": gen})
+
+        self._gen_step = jax.jit(gen_step)
+
+    def get_formatter(self, stage_name):
+        return flashy_tpu.Formatter({
+            "loss": ".4f", "mse": ".4f", "adv_gen": ".4f", "adv_disc": ".4f",
+        }, exclude_keys=["*"])
+
+    def do_train_valid(self, train: bool):
+        average = flashy_tpu.averager()
+        self.loader.set_epoch(self.epoch)
+        progress = self.log_progress(self.current_stage, self.loader, updates=2)
+        metrics = {}
+        for noise in progress:
+            noise = jnp.asarray(noise)
+            fake = self.student_model.apply(self.state["params"], noise)
+            real = self.teacher_model.apply(self.teacher, noise)
+            if train:
+                disc_loss = self.adv.train_adv(fake, real)
+                self.state, step_metrics = self._gen_step(
+                    self.state, self.adv.params, self.teacher, noise)
+                step_metrics["adv_disc"] = disc_loss
+            else:
+                mse = jnp.mean((fake - real) ** 2)
+                step_metrics = {"mse": mse}
+            metrics = average(step_metrics)
+            progress.update(**metrics)
+        return distrib.average_metrics(metrics, len(self.loader))
+
+    def run(self):
+        self.logger.info("Log dir: %s", self.folder)
+        self.restore()
+        for epoch in range(self.epoch, self.cfg.epochs + 1):
+            self.run_stage("train", self.do_train_valid, train=True)
+            self.run_stage("valid", self.do_train_valid, train=False)
+            self.commit()
+            if epoch == self.cfg.stop_at:
+                return
+
+
+@flashy_tpu.main(config_path="conf")
+def main(cfg):
+    flashy_tpu.setup_logging()
+    distrib.init()
+    Solver(cfg).run()
+
+
+if __name__ == "__main__":
+    main()
