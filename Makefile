@@ -1,0 +1,23 @@
+default: linter tests
+
+linter:
+	@if python -m flake8 --version >/dev/null 2>&1; then \
+		python -m flake8 --max-line-length=120 flashy_tpu tests examples bench.py __graft_entry__.py; \
+	else \
+		echo "flake8 not installed; running syntax check only"; \
+		python -m compileall -q flashy_tpu tests examples bench.py __graft_entry__.py; \
+	fi
+
+tests:
+	python -m pytest tests -x -q
+
+coverage:
+	coverage run -m pytest tests -q && coverage report -m --include='flashy_tpu/*'
+
+bench:
+	python bench.py
+
+dist:
+	python -m build --sdist
+
+.PHONY: default linter tests coverage bench dist

@@ -192,6 +192,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     block_k = min(block_k, t_k)
     if not _PALLAS_AVAILABLE or t_q % block_q or t_k % block_k:
         return dot_product_attention(q, k, v, causal=causal)
+    backend = jax.default_backend()
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        if backend == "cpu":
+            interpret = True  # interpret mode: correct, testable on CPU
+        elif backend in ("gpu", "cuda", "rocm"):
+            # TPU-only kernel (pltpu scratch/Mosaic); XLA handles GPU.
+            return dot_product_attention(q, k, v, causal=causal)
+        else:
+            # tpu, or TPU PJRT plugins under other names: real kernel.
+            interpret = False
     return _flash(q, k, v, causal, block_q, block_k, interpret)

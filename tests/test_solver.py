@@ -141,3 +141,15 @@ def test_restore_returns_false_without_checkpoint():
     with temporary_xp():
         solver = ToySolver()
         assert solver.restore() is False
+
+
+def test_profiling_writes_trace(tmp_path):
+    with temporary_xp():
+        solver = ToySolver()
+        solver.enable_profiling(folder=tmp_path / "prof", stages=["train"])
+        solver.run_stage("train", solver.train_stage)
+        import os
+        found = []
+        for root, _, files in os.walk(tmp_path / "prof"):
+            found += files
+        assert found  # some trace artifact was written
