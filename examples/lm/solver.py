@@ -48,7 +48,9 @@ class LMSolver(flashy_tpu.BaseSolver):
             vocab_size=cfg.model.vocab_size, dim=cfg.model.dim,
             num_layers=cfg.model.num_layers, num_heads=cfg.model.num_heads,
             mlp_ratio=cfg.model.mlp_ratio, attention=cfg.model.attention,
-            remat=cfg.model.get("remat", False))
+            remat=cfg.model.get("remat", False),
+            moe_experts=cfg.model.get("moe_experts", 0),
+            moe_top_k=cfg.model.get("moe_top_k", 1))
         self.mesh = make_mesh({k: v for k, v in cfg.mesh.items()})
         self.model = TransformerLM(model_cfg, mesh=self.mesh)
 
@@ -59,6 +61,9 @@ class LMSolver(flashy_tpu.BaseSolver):
             dataclasses_replace(model_cfg, attention="dense"))
         tokens0 = jnp.zeros((1, min(cfg.seq_len, 128)), jnp.int32)
         variables = init_model.init(jax.random.PRNGKey(0), tokens0)
+        # keep only real parameters — init may also return sown
+        # collections (MoE aux losses) that must not enter the optimizer
+        variables = {"params": variables["params"]}
         shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s),
             transformer_shardings(variables),
@@ -88,11 +93,22 @@ class LMSolver(flashy_tpu.BaseSolver):
 
         model, optim = self.model, self.optim
 
+        moe = model_cfg.moe_experts > 0
+        aux_weight = cfg.model.get("moe_aux_weight", 0.01)
+
         def train_step(state, tokens):
             def loss_fn(variables):
-                logits = model.apply(variables, tokens)
-                return optax.softmax_cross_entropy_with_integer_labels(
+                if moe:
+                    from flashy_tpu.models import moe_aux_loss
+                    logits, mutated = model.apply(variables, tokens,
+                                                  mutable=["losses"])
+                    aux = aux_weight * moe_aux_loss(mutated)
+                else:
+                    logits = model.apply(variables, tokens)
+                    aux = 0.0
+                ce = optax.softmax_cross_entropy_with_integer_labels(
                     logits[:, :-1], tokens[:, 1:]).mean()
+                return ce + aux
 
             loss, grads = jax.value_and_grad(loss_fn)(state["params"])
             updates, opt_state = optim.update(grads, state["opt_state"],

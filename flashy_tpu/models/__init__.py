@@ -3,5 +3,6 @@
 # resnet18) plus the Transformer LM flagship for the AudioCraft-style
 # downstream workload (BASELINE.json configs[4]). flake8: noqa
 from .mlp import MLP
+from .moe import MoEMLP, moe_aux_loss
 from .resnet import ResNet, resnet18, resnet34, resnet50
 from .transformer import TransformerLM, TransformerConfig, transformer_shardings

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import dot_product_attention, flash_attention
+from .moe import MoEMLP
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +40,9 @@ class TransformerConfig:
     dtype: tp.Any = jnp.bfloat16
     attention: str = "flash"     # 'flash' | 'dense' | 'ring'
     remat: bool = False          # jax.checkpoint each block (HBM for FLOPs)
+    moe_experts: int = 0         # >0 replaces the MLP with a routed MoE
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -115,8 +119,14 @@ class Block(nn.Module):
         cfg = self.config
         x = x + Attention(cfg, mesh=self.mesh, name="attn")(
             nn.RMSNorm(dtype=cfg.dtype, name="norm1")(x), positions, train)
-        x = x + MLPBlock(cfg, name="mlp")(
-            nn.RMSNorm(dtype=cfg.dtype, name="norm2")(x), train)
+        normed = nn.RMSNorm(dtype=cfg.dtype, name="norm2")(x)
+        if cfg.moe_experts > 0:
+            x = x + MoEMLP(dim=cfg.dim, hidden=cfg.dim * cfg.mlp_ratio,
+                           num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
+                           capacity_factor=cfg.moe_capacity_factor,
+                           dtype=cfg.dtype, name="moe")(normed)
+        else:
+            x = x + MLPBlock(cfg, name="mlp")(normed, train)
         return x
 
 
@@ -168,6 +178,9 @@ def transformer_shardings(params: tp.Any) -> tp.Any:
       attn out [H, Dh, D]     -> (tensor, None, fsdp)        row split
       mlp up [D, 2F]          -> (fsdp, tensor)              column split
       mlp down [F, D]         -> (tensor, fsdp)              row split
+      moe w_up [E, D, F]      -> (expert, fsdp, tensor)      expert parallel
+      moe w_down [E, F, D]    -> (expert, tensor, fsdp)
+      moe router [D, E]       -> replicated
       norms [D]               -> replicated
 
     Contractions over a 'tensor'-sharded dimension leave partial sums;
@@ -179,6 +192,12 @@ def transformer_shardings(params: tp.Any) -> tp.Any:
         joined = "/".join(str(getattr(p, "key", p)) for p in path)
         if "embed" in joined:
             return P("tensor", "fsdp")
+        if "moe/w_up" in joined:
+            return P("expert", "fsdp", "tensor")
+        if "moe/w_down" in joined:
+            return P("expert", "tensor", "fsdp")
+        if "router" in joined:
+            return P()
         if "qkv" in joined:
             return P("fsdp", None, "tensor", None)
         if "attn/out" in joined:

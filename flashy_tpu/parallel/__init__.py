@@ -7,3 +7,4 @@
 from .mesh import make_mesh, default_mesh, set_default_mesh, mesh_shape_from_devices
 from .data_parallel import wrap, shard_batch, replicate, fsdp_sharding, shard_params
 from .ring import ring_attention, ring_self_attention
+from .pipeline import pipeline

@@ -8,7 +8,10 @@
 #   'seq'    — sequence/context parallelism (ring attention)
 #
 # Axes of size 1 cost nothing, so solvers can always write sharding rules
-# against the full 4-axis mesh and scale any subset up later.
+# against the full six-axis mesh and scale any subset up later.
+#
+#   'expert' — expert parallelism (MoE): expert weight tables sharded
+#              over it; token dispatch/combine einsums become all-to-alls.
 """Mesh construction and the process-global default mesh."""
 import math
 import typing as tp
@@ -17,19 +20,25 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "fsdp", "tensor", "seq")
+#   'pipe'   — pipeline parallelism: layer stages sharded over it,
+#              activations stream stage-to-stage via ppermute (GPipe).
+AXES = ("data", "fsdp", "expert", "pipe", "tensor", "seq")
 
 _default_mesh: tp.Optional[Mesh] = None
 
 
 def mesh_shape_from_devices(n_devices: int,
                             tensor: int = 1, seq: int = 1,
-                            fsdp: int = 1) -> tp.Dict[str, int]:
+                            fsdp: int = 1, expert: int = 1,
+                            pipe: int = 1) -> tp.Dict[str, int]:
     """Fill the 'data' axis with whatever devices the others don't use."""
-    used = tensor * seq * fsdp
+    used = tensor * seq * fsdp * expert * pipe
     if n_devices % used:
-        raise ValueError(f"{n_devices} devices not divisible by tensor*seq*fsdp={used}")
-    return {"data": n_devices // used, "fsdp": fsdp, "tensor": tensor, "seq": seq}
+        raise ValueError(
+            f"{n_devices} devices not divisible by "
+            f"tensor*seq*fsdp*expert*pipe={used}")
+    return {"data": n_devices // used, "fsdp": fsdp, "expert": expert,
+            "pipe": pipe, "tensor": tensor, "seq": seq}
 
 
 def make_mesh(shape: tp.Optional[tp.Mapping[str, int]] = None,

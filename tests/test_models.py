@@ -160,3 +160,74 @@ def test_transformer_max_seq_len_enforced():
     import pytest
     with pytest.raises(ValueError):
         model.apply(variables, jnp.ones((1, 16), jnp.int32))
+
+
+def test_moe_expert_parallel_matches_replicated():
+    mesh = make_mesh({"data": 2, "expert": 2, "tensor": 2})
+    cfg = _tiny_cfg(moe_experts=4, moe_top_k=2)
+    model = TransformerLM(cfg, mesh=mesh)
+    tokens = np.random.default_rng(3).integers(0, 64, (8, 16)).astype(np.int32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(tokens[:2]))
+    variables = {"params": variables["params"]}  # drop sown collections
+
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), transformer_shardings(variables),
+        is_leaf=lambda x: isinstance(x, P))
+    params = jax.device_put(variables, shardings)
+    assert params["params"]["block_0"]["moe"]["w_up"].sharding.spec[0] == "expert"
+    batch = shard_batch(jnp.asarray(tokens), mesh, batch_axes=("data",))
+
+    def loss_fn(variables, tokens):
+        logits, mutated = model.apply(variables, tokens, mutable=["losses"])
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tokens[:, 1:]).mean()
+        from flashy_tpu.models import moe_aux_loss
+        return ce + 0.01 * moe_aux_loss(mutated)
+
+    sharded = float(jax.jit(loss_fn)(params, batch))
+    replicated = float(loss_fn(variables, jnp.asarray(tokens)))
+    assert abs(sharded - replicated) < 5e-3
+
+    grads = jax.jit(jax.grad(loss_fn))(params, batch)
+    norms = [float(jnp.linalg.norm(g)) for g in
+             jax.tree_util.tree_leaves(grads)]
+    assert all(np.isfinite(n) for n in norms)
+    # router and experts actually receive gradient
+    g_router = grads["params"]["block_0"]["moe"]["router"]["kernel"]
+    assert float(jnp.abs(g_router).max()) > 0
+
+
+def test_moe_routing_no_slot_collisions_and_capacity():
+    # Each (expert, slot) pair receives at most ONE token even with
+    # top_k=2, and capacity scales with top_k.
+    from flashy_tpu.models.moe import MoEMLP
+    model = MoEMLP(dim=8, hidden=16, num_experts=2, top_k=2,
+                   capacity_factor=2.0)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 16, 8)),
+                    jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+
+    # mirror the routing math standalone with the model's actual router
+    router_kernel = variables["params"]["router"]["kernel"]
+    n, e = 16, 2
+    logits = np.asarray(x.reshape(n, 8) @ router_kernel)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    capacity = max(1, int(2.0 * n * 2 / e))
+    occupancy = np.zeros((e, capacity))
+    counts = np.zeros(e)
+    remaining = probs.copy()
+    for _ in range(2):
+        idx = remaining.argmax(-1)
+        mask = np.eye(e)[idx]
+        pos = (np.cumsum(mask, 0) - 1 + counts[None, :]) * mask
+        within = pos < capacity
+        mask = mask * within
+        for token in range(n):
+            for ex in range(e):
+                if mask[token, ex]:
+                    occupancy[ex, int(pos[token, ex])] += 1
+        counts += mask.sum(0)
+        remaining = remaining * (1 - np.eye(e)[idx])
+    assert occupancy.max() <= 1.0  # no collisions
+    assert capacity == 32  # scales with top_k (2.0 * 16 * 2 / 2)

@@ -18,7 +18,8 @@ def test_make_mesh_shapes():
     mesh = make_mesh({"data": -1})
     assert mesh.shape["data"] == 8
     mesh = make_mesh({"data": 2, "fsdp": 2, "tensor": 2})
-    assert mesh.shape == {"data": 2, "fsdp": 2, "tensor": 2, "seq": 1}
+    assert mesh.shape == {"data": 2, "fsdp": 2, "expert": 1, "pipe": 1,
+                          "tensor": 2, "seq": 1}
     with pytest.raises(ValueError):
         make_mesh({"data": 3})
     with pytest.raises(ValueError):
@@ -167,3 +168,46 @@ def test_wrap_three_tuple_and_bare_outputs(mesh8):
 
     out = step1(jnp.zeros(()), shard_batch(jnp.ones((8, 2)), mesh8))
     assert float(out) == 16.0
+
+
+def test_pipeline_matches_sequential():
+    from flashy_tpu.parallel import pipeline
+    from jax.sharding import NamedSharding
+    mesh = make_mesh({"pipe": 4, "data": 2})
+    rng = np.random.default_rng(7)
+    stages, dim, batch = 4, 16, 8
+    params = {"w": jnp.asarray(rng.normal(size=(stages, dim, dim)).astype(np.float32) * 0.3)}
+    x = jnp.asarray(rng.normal(size=(batch, dim)).astype(np.float32))
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    sharded = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+    out = jax.jit(lambda p, x: pipeline(stage_fn, p, x, mesh=mesh,
+                                        num_microbatches=4))(sharded, x)
+    ref = x
+    for s in range(stages):
+        ref = stage_fn({"w": params["w"][s]}, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    grad_pipe = jax.jit(jax.grad(
+        lambda p, x: (pipeline(stage_fn, p, x, mesh=mesh) ** 2).sum()))(sharded, x)
+
+    def seq_loss(p, x):
+        h = x
+        for s in range(stages):
+            h = stage_fn({"w": p["w"][s]}, h)
+        return (h ** 2).sum()
+
+    grad_ref = jax.grad(seq_loss)(params, x)
+    np.testing.assert_allclose(np.asarray(grad_pipe["w"]),
+                               np.asarray(grad_ref["w"]), rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_single_stage_degenerate():
+    from flashy_tpu.parallel import pipeline
+    mesh = make_mesh({"data": -1})  # pipe axis size 1
+    params = {"w": jnp.ones((1, 4, 4))}
+    x = jnp.ones((2, 4))
+    out = pipeline(lambda p, h: h @ p["w"], params, x, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ params["w"][0]))
