@@ -50,7 +50,13 @@ class LMSolver(flashy_tpu.BaseSolver):
             mlp_ratio=cfg.model.mlp_ratio, attention=cfg.model.attention,
             remat=cfg.model.get("remat", False),
             moe_experts=cfg.model.get("moe_experts", 0),
-            moe_top_k=cfg.model.get("moe_top_k", 1))
+            moe_top_k=cfg.model.get("moe_top_k", 1),
+            moe_capacity_factor=cfg.model.get("moe_capacity_factor", 1.25))
+        if cfg.mesh.get("pipe", 1) > 1:
+            raise ValueError(
+                "examples.lm does not pipeline the block stack; mesh.pipe>1 "
+                "would silently replicate compute. Use "
+                "flashy_tpu.parallel.pipeline for stage-stacked models.")
         self.mesh = make_mesh({k: v for k, v in cfg.mesh.items()})
         self.model = TransformerLM(model_cfg, mesh=self.mesh)
 
@@ -149,14 +155,36 @@ class LMSolver(flashy_tpu.BaseSolver):
         metrics["tokens_per_sec"] = tokens_seen / (time.time() - begin)
         return metrics
 
+    def generate(self):
+        """Sample a continuation with the KV-cache decoder and log it."""
+        from flashy_tpu.models import generate as lm_generate
+        import time
+        prompt = jnp.asarray(self._stream(2, 16, step=0)[:, :16])
+        begin = time.time()
+        out = lm_generate(self.model, self.state["params"], prompt,
+                          max_new_tokens=32, temperature=1.0,
+                          rng=jax.random.PRNGKey(self.epoch))
+        out = jax.device_get(out)
+        self.log_text("generate", "sample",
+                      " ".join(str(int(t)) for t in out[0]))
+        return {"gen_tokens_per_sec": out.shape[0] * 32 / (time.time() - begin)}
+
     def run(self):
         restored = self.restore()
         if restored:
             self.state = jax.tree_util.tree_map(
                 jax.device_put, self.state, self._state_shardings)
         self.logger.info("Restored: %s; starting at epoch %d", restored, self.epoch)
+        want_generate = bool(self.cfg.get("generate_every"))
+        if want_generate and self.cfg.model.get("moe_experts", 0) > 0:
+            self.logger.warning(
+                "generate stage disabled: cached decoding does not support "
+                "MoE models yet")
+            want_generate = False
         for epoch in range(self.epoch, self.cfg.epochs + 1):
             self.run_stage("train", self.train)
+            if want_generate and epoch % self.cfg.generate_every == 0:
+                self.run_stage("generate", self.generate)
             self.commit()
 
 

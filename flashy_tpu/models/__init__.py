@@ -6,3 +6,4 @@ from .mlp import MLP
 from .moe import MoEMLP, moe_aux_loss
 from .resnet import ResNet, resnet18, resnet34, resnet50
 from .transformer import TransformerLM, TransformerConfig, transformer_shardings
+from .decoding import generate, init_cache

@@ -1,0 +1,153 @@
+# Autoregressive decoding for TransformerLM: KV-cached generation under
+# lax.scan — the "generate" stage counterpart of the training path
+# (AudioCraft-style solvers interleave train/valid/generate stages; the
+# reference framework is model-agnostic but its downstream users need
+# this). TPU-first: static shapes throughout (cache laid out at
+# max_len), one fused scan instead of a python token loop, greedy or
+# temperature/top-k sampling.
+"""KV-cache decoding: generate(model, params, prompt, ...) -> tokens."""
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import TransformerConfig, _rotary
+
+
+def _split_heads(qkv: jax.Array) -> tp.Tuple[jax.Array, jax.Array, jax.Array]:
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> tp.Dict:
+    """Allocate the static-shape KV cache for every layer."""
+    shape = (batch, max_len, cfg.num_heads, cfg.head_dim)
+    return {
+        f"block_{i}": {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+        }
+        for i in range(cfg.num_layers)
+    }
+
+
+def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
+                positions: jax.Array, cache: tp.Dict, cache_index: jax.Array):
+    """Forward `tokens` [B, S] at `positions`, reading+writing the cache.
+
+    Re-implements the block stack against cached K/V (the training
+    module computes full-sequence attention; decoding attends to the
+    cache prefix). Weights are read from the same parameter tree.
+    """
+    p = params["params"]
+    x = jnp.take(p["embed"], tokens, axis=0).astype(cfg.dtype)
+    batch, seq = tokens.shape
+    new_cache = {}
+    for layer in range(cfg.num_layers):
+        bp = p[f"block_{layer}"]
+        normed = _rmsnorm(x, bp["norm1"]["scale"], cfg.dtype)
+        qkv = jnp.einsum("btd,dchk->btchk", normed,
+                         bp["attn"]["qkv"]["kernel"].astype(cfg.dtype))
+        q, k, v = _split_heads(qkv)
+        q = _rotary(q, positions)
+        k = _rotary(k, positions)
+        layer_cache = cache[f"block_{layer}"]
+        k_cache = jax.lax.dynamic_update_slice(
+            layer_cache["k"], k.astype(cfg.dtype), (0, cache_index, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            layer_cache["v"], v.astype(cfg.dtype), (0, cache_index, 0, 0))
+        new_cache[f"block_{layer}"] = {"k": k_cache, "v": v_cache}
+
+        # Attend over the cache prefix [0, cache_index + seq).
+        max_len = k_cache.shape[1]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                            preferred_element_type=jnp.float32) * scale
+        key_pos = jnp.arange(max_len)[None, :]
+        query_pos = positions[:, :, None]  # [B, S, 1] global positions
+        mask = key_pos[None] <= query_pos  # causal over the cache
+        scores = jnp.where(mask[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v_cache)
+        attn_out = jnp.einsum("bqhd,hdD->bqD", attn,
+                              bp["attn"]["out"]["kernel"].astype(cfg.dtype))
+        x = x + attn_out
+
+        normed = _rmsnorm(x, bp["norm2"]["scale"], cfg.dtype)
+        up = jnp.einsum("btd,df->btf", normed,
+                        bp["mlp"]["up"]["kernel"].astype(cfg.dtype))
+        gate, value = jnp.split(up, 2, axis=-1)
+        mlp_out = jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * value,
+                             bp["mlp"]["down"]["kernel"].astype(cfg.dtype))
+        x = x + mlp_out
+
+    x = _rmsnorm(x, p["norm_f"]["scale"], cfg.dtype)
+    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                        p["embed"].astype(jnp.float32))
+    return logits, new_cache
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    norm = jnp.asarray(x, jnp.float32)
+    norm = norm * jax.lax.rsqrt(jnp.mean(norm * norm, -1, keepdims=True) + 1e-6)
+    return (norm * scale.astype(jnp.float32)).astype(dtype)
+
+
+def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
+             temperature: float = 0.0, top_k: tp.Optional[int] = None,
+             rng: tp.Optional[jax.Array] = None) -> jax.Array:
+    """Autoregressive generation with a KV cache.
+
+    Args:
+        model: a TransformerLM (its config drives shapes). MoE and ring
+            attention models are not supported in the cached decode path
+            yet — use dense/flash training attention variants.
+        params: the model's variables ({'params': ...}).
+        prompt: [B, P] int32 prompt tokens.
+        max_new_tokens: tokens to append.
+        temperature: 0 -> greedy; >0 -> sampling.
+        top_k: restrict sampling to the k most likely tokens.
+        rng: PRNG key (required when temperature > 0).
+
+    Returns [B, P + max_new_tokens] tokens. Jit-compatible: shapes are
+    static in P and max_new_tokens.
+    """
+    cfg: TransformerConfig = model.config
+    if cfg.moe_experts > 0:
+        raise NotImplementedError("cached decoding with MoE not supported yet")
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(f"prompt + new tokens {total} > max_seq_len {cfg.max_seq_len}")
+    cache = init_cache(cfg, batch, total)
+
+    # Prefill: run the whole prompt through once.
+    positions = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32)[None],
+                                 (batch, prompt_len))
+    logits, cache = _apply_step(model, params, cfg, prompt, positions, cache,
+                                jnp.int32(0))
+    last_logits = logits[:, -1]
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    def step(carry, t):
+        last_logits, cache, key = carry
+        key, sub = jax.random.split(key)
+        token = sample(last_logits, sub)
+        position = jnp.broadcast_to(prompt_len + t, (batch, 1)).astype(jnp.int32)
+        logits, cache = _apply_step(model, params, cfg, token[:, None],
+                                    position, cache, prompt_len + t)
+        return (logits[:, -1], cache, key), token
+
+    (_, _, _), tokens = jax.lax.scan(
+        step, (last_logits, cache, rng), jnp.arange(max_new_tokens))
+    return jnp.concatenate([prompt, tokens.T.astype(prompt.dtype)], axis=1)

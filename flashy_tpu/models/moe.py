@@ -100,6 +100,9 @@ class MoEMLP(nn.Module):
         self.sow("losses", "moe_aux", aux)
 
         dispatch = (combine > 0.0).astype(self.dtype)          # [N, E, C]
+        # Exposed for tests/debugging (dead-code-eliminated unless the
+        # caller requests mutable=['intermediates']).
+        self.sow("intermediates", "dispatch", dispatch)
 
         # Expert weight tables [E, ...]: shard dim 0 over 'expert'.
         w_up = self.param("w_up", nn.initializers.lecun_normal(),
