@@ -102,21 +102,25 @@ class LMSolver(flashy_tpu.BaseSolver):
         moe = model_cfg.moe_experts > 0
         aux_weight = cfg.model.get("moe_aux_weight", 0.01)
 
-        def train_step(state, tokens):
-            def loss_fn(variables):
-                if moe:
-                    from flashy_tpu.models import moe_aux_loss
-                    logits, mutated = model.apply(variables, tokens,
-                                                  mutable=["losses"])
-                    aux = aux_weight * moe_aux_loss(mutated)
-                else:
-                    logits = model.apply(variables, tokens)
-                    aux = 0.0
-                ce = optax.softmax_cross_entropy_with_integer_labels(
-                    logits[:, :-1], tokens[:, 1:]).mean()
-                return ce + aux
+        def loss_fn(variables, tokens):
+            if moe:
+                from flashy_tpu.models import moe_aux_loss
+                logits, mutated = model.apply(variables, tokens,
+                                              mutable=["losses"])
+                aux = aux_weight * moe_aux_loss(mutated)
+            else:
+                logits = model.apply(variables, tokens)
+                aux = 0.0
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+            return ce + aux
 
-            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        from flashy_tpu.parallel import with_grad_accumulation
+        grad_fn = with_grad_accumulation(
+            jax.value_and_grad(loss_fn), cfg.get("accumulate", 1))
+
+        def train_step(state, tokens):
+            loss, grads = grad_fn(state["params"], tokens)
             updates, opt_state = optim.update(grads, state["opt_state"],
                                               state["params"])
             params = optax.apply_updates(state["params"], updates)

@@ -104,6 +104,32 @@ def to_torch_state_dict(tree: tp.Any, prefix: str = "") -> tp.Dict[str, tp.Any]:
     return flat
 
 
+def import_flashy_checkpoint(path: AnyPath) -> tp.Dict[str, tp.Any]:
+    """Load a reference-flashy `checkpoint.th` (torch.save format).
+
+    Returns the solver-level state dict with torch tensors converted to
+    numpy (nested flat state dicts are unflattened into pytrees), ready
+    to feed `BaseSolver.load_state_dict` or to seed JAX params. Entries
+    the reference always writes — 'history', 'xp.cfg', 'xp.sig'
+    (reference flashy/solver.py:34-35) — pass through untouched.
+    """
+    import torch
+    raw = torch.load(str(path), map_location="cpu", weights_only=False)
+
+    def convert(node: tp.Any) -> tp.Any:
+        # Deep conversion: optimizer states nest tensors several levels
+        # down ({'state': {0: {'exp_avg': tensor}}, 'param_groups': ...}).
+        if hasattr(node, "detach"):
+            return node.detach().cpu().numpy()
+        if isinstance(node, tp.Mapping):
+            return {key: convert(value) for key, value in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(convert(value) for value in node)
+        return node
+
+    return {name: convert(entry) for name, entry in raw.items()}
+
+
 def from_torch_state_dict(state_dict: tp.Mapping[str, tp.Any]) -> tp.Dict[str, tp.Any]:
     """Unflatten a torch-style state dict ('.'-joined keys, tensor leaves)
     into a nested dict of numpy arrays usable as a JAX pytree."""

@@ -94,8 +94,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
             probs, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        m_scr[:] = m_new[:, None]
-        l_scr[:] = l_new[:, None]
+        # Scratch rows are 128 lanes wide (the native f32 tile); the
+        # scalar running stats live broadcast across the lane dim.
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
     if causal:
         # Fully-future blocks contribute nothing; skip their MXU work
@@ -143,8 +145,8 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
         out_specs=pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((batch * heads, t_q, dim), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),    # running max
-            pltpu.VMEM((block_q, 1), jnp.float32),    # running normalizer
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer
             pltpu.VMEM((block_q, dim), jnp.float32),  # output accumulator
         ],
         interpret=interpret,

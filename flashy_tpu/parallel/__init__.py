@@ -5,6 +5,7 @@
 # shards arrays over a jax.sharding.Mesh and lets XLA insert and overlap
 # the collectives over ICI/DCN. flake8: noqa
 from .mesh import make_mesh, default_mesh, set_default_mesh, mesh_shape_from_devices
-from .data_parallel import wrap, shard_batch, replicate, fsdp_sharding, shard_params
+from .data_parallel import (wrap, shard_batch, replicate, fsdp_sharding,
+                            shard_params, with_grad_accumulation)
 from .ring import ring_attention, ring_self_attention
 from .pipeline import pipeline

@@ -11,6 +11,7 @@
 import typing as tp
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -83,6 +84,51 @@ def shard_params(params: tp.Any, mesh: tp.Optional[Mesh] = None,
     """Apply `fsdp_sharding` placements to a concrete parameter pytree."""
     shardings = fsdp_sharding(params, mesh, axis, min_size)
     return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+def with_grad_accumulation(value_and_grad_fn: tp.Callable,
+                           num_microbatches: int) -> tp.Callable:
+    """Split the batch into microbatches and accumulate gradients.
+
+    Wraps `value_and_grad_fn(params, batch, *rest) -> (loss, grads)`
+    (a mean-reduced loss) into a function with identical signature and
+    results, but peak activation memory divided by `num_microbatches`:
+    the microbatches run sequentially under `lax.scan` with a running
+    gradient sum. Composes with `wrap` — accumulate first, then shard::
+
+        grad_fn = with_grad_accumulation(jax.value_and_grad(loss_fn), 8)
+
+    The batch's leading dim must divide by `num_microbatches`.
+    """
+    if num_microbatches <= 1:
+        return value_and_grad_fn
+
+    def wrapped(params, batch, *rest):
+        def split(x):
+            return x.reshape(num_microbatches, x.shape[0] // num_microbatches,
+                             *x.shape[1:])
+
+        micro = jax.tree_util.tree_map(split, batch)
+
+        def body(carry, microbatch):
+            loss_acc, grad_acc = carry
+            loss, grads = value_and_grad_fn(params, microbatch, *rest)
+            grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
+            return (loss_acc + loss, grad_acc), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, _grad_dtype(p)), params)
+        (loss, grads), _ = jax.lax.scan(body, (jnp.zeros(()), zeros), micro)
+        scale = 1.0 / num_microbatches
+        return loss * scale, jax.tree_util.tree_map(
+            lambda g: g * scale, grads)
+
+    return wrapped
+
+
+def _grad_dtype(p):
+    dtype = np.dtype(p.dtype)
+    return dtype if np.issubdtype(dtype, np.floating) else np.float32
 
 
 def wrap(step_fn: tp.Optional[tp.Callable] = None, *,

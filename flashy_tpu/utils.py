@@ -85,6 +85,20 @@ def freeze(tree: tp.Any) -> tp.Any:
 readonly = freeze
 
 
+def model_key(seed: int = 0) -> "jax.Array":
+    """PRNG key identical on every process: use for parameter init so
+    all workers start from the same model (pairs with, or replaces, an
+    explicit `distrib.broadcast_model`)."""
+    return jax.random.PRNGKey(seed)
+
+
+def data_key(seed: int = 0) -> "jax.Array":
+    """PRNG key distinct per process: use for data augmentation /
+    sampling so workers do not duplicate randomness."""
+    from .distrib import rank  # env-first; never forces backend init
+    return jax.random.fold_in(jax.random.PRNGKey(seed), rank())
+
+
 def to_numpy(tree: tp.Any) -> tp.Any:
     """Convert every array leaf of a pytree to a host numpy array.
 

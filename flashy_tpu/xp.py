@@ -306,6 +306,21 @@ class _EntryPoint:
             set_by_path(cfg, key, value)
         return cfg, flags
 
+    def _usage(self) -> str:
+        lines = [
+            f"usage: {sys.argv[0]} [--clear] [--workers=N] [key=value ...]",
+            "",
+            "  key=value      override a config key (YAML-typed; nested via dots)",
+            "  --clear        delete this config's XP folder and start fresh",
+            "  --workers=N    spawn N distributed worker processes on this host",
+            "                 (alias: --ddp_workers=N)",
+        ]
+        if self.config_path is not None:
+            lines.append(f"  config: {self.config_path / (self.config_name + '.yaml')}")
+        if self.fn.__doc__:
+            lines = [self.fn.__doc__.strip(), ""] + lines
+        return "\n".join(lines)
+
     def get_xp(self, argv: tp.Optional[tp.Sequence[str]] = None) -> XP:
         cfg, _ = self._resolve(list(argv or []))
         return create_xp(cfg, root=self.dir, argv=list(argv or []))
@@ -323,6 +338,9 @@ class _EntryPoint:
             import jax
             jax.config.update("jax_platforms", platform)
         argv = list(sys.argv[1:] if argv is None else argv)
+        if "--help" in argv or "-h" in argv:
+            print(self._usage())
+            return None
         cfg, flags = self._resolve(argv)
         xp = create_xp(cfg, root=self.dir, argv=argv)
         is_spawned_worker = "FLASHY_TPU_PROCESS_ID" in os.environ

@@ -211,3 +211,28 @@ def test_pipeline_single_stage_degenerate():
     x = jnp.ones((2, 4))
     out = pipeline(lambda p, h: h @ p["w"], params, x, mesh=mesh)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x @ params["w"][0]))
+
+
+def test_grad_accumulation_matches_full_batch():
+    from flashy_tpu.parallel import with_grad_accumulation
+    rng = np.random.default_rng(11)
+    w = jnp.asarray(rng.normal(size=(6, 3)).astype(np.float32))
+    batch = {"x": jnp.asarray(rng.normal(size=(16, 6)).astype(np.float32)),
+             "y": jnp.asarray(rng.normal(size=(16, 3)).astype(np.float32))}
+
+    def loss_fn(w, batch):
+        return jnp.mean((batch["x"] @ w - batch["y"]) ** 2)
+
+    full = jax.value_and_grad(loss_fn)
+    accum = with_grad_accumulation(full, 4)
+    loss_a, grads_a = jax.jit(accum)(w, batch)
+    loss_b, grads_b = full(w, batch)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads_a), np.asarray(grads_b),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accumulation_identity_for_one():
+    from flashy_tpu.parallel import with_grad_accumulation
+    fn = jax.value_and_grad(lambda w, b: (w * b).sum())
+    assert with_grad_accumulation(fn, 1) is fn

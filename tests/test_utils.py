@@ -76,3 +76,14 @@ def test_to_numpy_and_tree_bytes():
     assert isinstance(host["a"], np.ndarray)
     assert host["b"][1] == "str"
     assert tree_bytes(tree) == 2 * 3 * 4 + 4 * 8
+
+
+def test_prng_key_helpers():
+    from flashy_tpu.utils import data_key, model_key
+    a = model_key(0)
+    b = model_key(0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # data_key folds the rank in, so it differs from the raw seed key
+    d = data_key(0)
+    assert d.shape == a.shape
+    assert not np.array_equal(np.asarray(d), np.asarray(a))
