@@ -127,7 +127,18 @@ def import_flashy_checkpoint(path: AnyPath) -> tp.Dict[str, tp.Any]:
             return type(node)(convert(value) for value in node)
         return node
 
-    return {name: convert(entry) for name, entry in raw.items()}
+    def maybe_unflatten(entry: tp.Any) -> tp.Any:
+        # Module state dicts are flat with '.'-joined keys
+        # ('layers.0.weight'); turn them into nested pytrees so they can
+        # seed JAX params directly.
+        if isinstance(entry, tp.Mapping) and entry and all(
+                isinstance(k, str) for k in entry) and any(
+                "." in k for k in entry):
+            return from_torch_state_dict(entry)
+        return entry
+
+    return {name: maybe_unflatten(convert(entry))
+            for name, entry in raw.items()}
 
 
 def from_torch_state_dict(state_dict: tp.Mapping[str, tp.Any]) -> tp.Dict[str, tp.Any]:

@@ -7,3 +7,4 @@ from .moe import MoEMLP, moe_aux_loss
 from .resnet import ResNet, resnet18, resnet34, resnet50
 from .transformer import TransformerLM, TransformerConfig, transformer_shardings
 from .decoding import generate, init_cache
+from .pipelined import pipelined_apply

@@ -11,7 +11,7 @@ import typing as tp
 import jax
 import jax.numpy as jnp
 
-from .transformer import TransformerConfig, _rotary
+from .transformer import TransformerConfig, _rotary, rmsnorm as _rmsnorm
 
 
 def _split_heads(qkv: jax.Array) -> tp.Tuple[jax.Array, jax.Array, jax.Array]:
@@ -86,12 +86,6 @@ def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
     return logits, new_cache
 
 
-def _rmsnorm(x: jax.Array, scale: jax.Array, dtype) -> jax.Array:
-    norm = jnp.asarray(x, jnp.float32)
-    norm = norm * jax.lax.rsqrt(jnp.mean(norm * norm, -1, keepdims=True) + 1e-6)
-    return (norm * scale.astype(jnp.float32)).astype(dtype)
-
-
 def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: tp.Optional[int] = None,
              rng: tp.Optional[jax.Array] = None) -> jax.Array:
@@ -114,6 +108,10 @@ def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
     cfg: TransformerConfig = model.config
     if cfg.moe_experts > 0:
         raise NotImplementedError("cached decoding with MoE not supported yet")
+    if cfg.scan_layers:
+        raise NotImplementedError(
+            "cached decoding reads per-layer params (block_i); "
+            "scan-stacked models are not supported yet")
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:

@@ -1,0 +1,66 @@
+# Pipeline-parallel execution of a scan-stacked TransformerLM: the
+# block stack (params with leading [num_layers] dim, see
+# TransformerConfig.scan_layers) is split into `pipe` stages; embedding
+# and head replicate while activations stream through the stages with
+# the GPipe schedule of flashy_tpu.parallel.pipeline.
+"""pipelined_apply: run a scan-stacked TransformerLM over the 'pipe' axis."""
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.pipeline import pipeline
+from .transformer import Block, TransformerLM, rmsnorm as _rmsnorm
+
+
+def pipelined_apply(model: TransformerLM, variables: tp.Mapping,
+                    tokens: jax.Array, *, mesh=None,
+                    num_microbatches: tp.Optional[int] = None) -> jax.Array:
+    """Forward a scan-stacked TransformerLM with pipeline parallelism.
+
+    Requirements: `config.scan_layers=True`, `num_layers` divisible by
+    the mesh's 'pipe' size, no dropout (eval-mode blocks) and no MoE
+    (sown aux losses cannot cross the pipeline boundary yet). Gradients
+    flow: wrap in jax.grad for pipelined training.
+    """
+    cfg = model.config
+    if not cfg.scan_layers:
+        raise ValueError("pipelined_apply needs TransformerConfig.scan_layers=True")
+    if cfg.moe_experts > 0:
+        raise NotImplementedError("pipelined_apply does not support MoE yet")
+    from ..parallel.mesh import default_mesh
+    mesh = mesh or default_mesh()
+    num_stages = mesh.shape["pipe"]
+    if cfg.num_layers % num_stages:
+        raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
+                         f"pipe={num_stages}")
+    layers_per_stage = cfg.num_layers // num_stages
+
+    params = variables["params"]
+    embedding = params["embed"]
+    x = jnp.take(embedding, tokens, axis=0).astype(cfg.dtype)
+
+    block_params = params["blocks"]["block"]  # stacked [L, ...]
+    stage_params = jax.tree_util.tree_map(
+        lambda a: a.reshape(num_stages, layers_per_stage, *a.shape[1:]),
+        block_params)
+
+    block = Block(cfg)
+
+    def stage_fn(local_params, h):
+        # h: [mb, T, D]; local_params leaves: [layers_per_stage, ...]
+        positions = jnp.broadcast_to(
+            jnp.arange(h.shape[1], dtype=jnp.int32)[None, :], h.shape[:2])
+
+        def body(carry, layer_params):
+            out = block.apply({"params": layer_params}, carry, positions)
+            return out, None
+
+        h, _ = jax.lax.scan(body, h, local_params)
+        return h
+
+    x = pipeline(stage_fn, stage_params, x, mesh=mesh,
+                 num_microbatches=num_microbatches)
+    x = _rmsnorm(x, params["norm_f"]["scale"], cfg.dtype)
+    return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), embedding,
+                      preferred_element_type=jnp.float32)

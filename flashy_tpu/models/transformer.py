@@ -43,10 +43,22 @@ class TransformerConfig:
     moe_experts: int = 0         # >0 replaces the MLP with a routed MoE
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
+    scan_layers: bool = False    # nn.scan-stack the blocks: params get a
+                                 # leading [num_layers] dim (O(1) compile
+                                 # time in depth; enables 'pipe' sharding
+                                 # and pipelined_apply)
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.num_heads
+
+
+def rmsnorm(x: jax.Array, scale: jax.Array, dtype: tp.Any) -> jax.Array:
+    """Functional RMSNorm matching nn.RMSNorm's math (f32 accumulation,
+    eps 1e-6); used by the decode/pipelined paths that read raw params."""
+    h = jnp.asarray(x, jnp.float32)
+    h = h * jax.lax.rsqrt(jnp.mean(h * h, -1, keepdims=True) + 1e-6)
+    return (h * scale.astype(jnp.float32)).astype(dtype)
 
 
 def _rotary(x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -130,6 +142,25 @@ class Block(nn.Module):
         return x
 
 
+class _CarryBlock(nn.Module):
+    """Block wrapper with scan-compatible (carry, out) signature.
+
+    `train` is a (static) module attribute so nn.scan only sees array
+    arguments.
+    """
+
+    config: TransformerConfig
+    mesh: tp.Any = None
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions):
+        block = nn.remat(Block, static_argnums=(3,)) if self.config.remat else Block
+        y = block(self.config, mesh=self.mesh, name="block")(
+            x, positions, self.train)
+        return y, None
+
+
 class TransformerLM(nn.Module):
     """Decoder-only LM: tokens [B, T] int32 -> logits [B, T, vocab]."""
 
@@ -154,12 +185,21 @@ class TransformerLM(nn.Module):
             "embed", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.dim),
             jnp.float32)
         x = jnp.take(embedding, tokens, axis=0).astype(cfg.dtype)
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, static_argnums=(3,))
-        for layer in range(cfg.num_layers):
-            x = block(cfg, mesh=self.mesh, name=f"block_{layer}")(
-                x, positions, train)
+        if cfg.scan_layers:
+            # One compiled block body, scanned over a stacked [L, ...]
+            # parameter dim — the idiomatic deep-model layout on TPU.
+            scan_block = nn.scan(
+                _CarryBlock, variable_axes={"params": 0, "losses": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=nn.broadcast,
+                length=cfg.num_layers)
+            x, _ = scan_block(cfg, mesh=self.mesh, train=train,
+                              name="blocks")(x, positions)
+        else:
+            block = nn.remat(Block, static_argnums=(3,)) if cfg.remat else Block
+            for layer in range(cfg.num_layers):
+                x = block(cfg, mesh=self.mesh, name=f"block_{layer}")(
+                    x, positions, train)
         x = nn.RMSNorm(dtype=cfg.dtype, name="norm_f")(x)
         # Tied output head, f32 accumulation for a stable cross-entropy.
         logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), embedding,
@@ -193,19 +233,25 @@ def transformer_shardings(params: tp.Any) -> tp.Any:
         if "embed" in joined:
             return P("tensor", "fsdp")
         if "moe/w_up" in joined:
-            return P("expert", "fsdp", "tensor")
-        if "moe/w_down" in joined:
-            return P("expert", "tensor", "fsdp")
-        if "router" in joined:
-            return P()
-        if "qkv" in joined:
-            return P("fsdp", None, "tensor", None)
-        if "attn/out" in joined:
-            return P("tensor", None, "fsdp")
-        if "mlp/up" in joined:
-            return P("fsdp", "tensor")
-        if "mlp/down" in joined:
-            return P("tensor", "fsdp")
-        return P()
+            base: tp.Tuple = ("expert", "fsdp", "tensor")
+        elif "moe/w_down" in joined:
+            base = ("expert", "tensor", "fsdp")
+        elif "router" in joined:
+            base = ()
+        elif "qkv" in joined:
+            base = ("fsdp", None, "tensor", None)
+        elif "attn/out" in joined:
+            base = ("tensor", None, "fsdp")
+        elif "mlp/up" in joined:
+            base = ("fsdp", "tensor")
+        elif "mlp/down" in joined:
+            base = ("tensor", "fsdp")
+        else:
+            base = ()
+        if "blocks/" in joined:
+            # scan-stacked layout: leading [num_layers] dim shards over
+            # 'pipe' (a no-op when the pipe axis has size 1).
+            return P("pipe", *base)
+        return P(*base)
 
     return jax.tree_util.tree_map_with_path(spec_for, params)

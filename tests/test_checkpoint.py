@@ -133,3 +133,18 @@ def test_import_flashy_checkpoint_nested_optimizer():
         imported = import_flashy_checkpoint(path)
     exp_avg = imported["optim"]["state"][0]["exp_avg"]
     assert isinstance(exp_avg, np.ndarray)  # deep conversion reached it
+
+
+def test_import_flashy_checkpoint_unflattens_dotted_keys():
+    torch = pytest.importorskip("torch")
+    import tempfile
+    from flashy_tpu.checkpoint import import_flashy_checkpoint
+
+    model = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.Linear(8, 2))
+    with tempfile.TemporaryDirectory() as tmp:
+        path = tmp + "/checkpoint.th"
+        torch.save({"model": model.state_dict()}, path)
+        imported = import_flashy_checkpoint(path)
+    # '0.weight' -> nested {'0': {'weight': ...}}
+    assert imported["model"]["0"]["weight"].shape == (8, 4)
+    assert imported["model"]["1"]["bias"].shape == (2,)
