@@ -85,6 +85,21 @@ def freeze(tree: tp.Any) -> tp.Any:
 readonly = freeze
 
 
+def pin_platform(default: tp.Optional[str] = None) -> None:
+    """Honor an explicit platform request against site configuration.
+
+    Site customizations (TPU plugin autoload) can pin a platform at
+    interpreter start, overriding the `JAX_PLATFORMS` env var. This
+    applies the user's explicit choice — `FLASHY_TPU_PLATFORM`, then
+    `JAX_PLATFORMS`, then `default` — through `jax.config`, which wins.
+    Call before any device query.
+    """
+    choice = (os.environ.get("FLASHY_TPU_PLATFORM")
+              or os.environ.get("JAX_PLATFORMS") or default)
+    if choice:
+        jax.config.update("jax_platforms", choice.strip().lower())
+
+
 def model_key(seed: int = 0) -> "jax.Array":
     """PRNG key identical on every process: use for parameter init so
     all workers start from the same model (pairs with, or replaces, an

@@ -330,13 +330,10 @@ class _EntryPoint:
 
     def __call__(self, argv: tp.Optional[tp.Sequence[str]] = None):
         # Platform pinning via env (e.g. FLASHY_TPU_PLATFORM=cpu for
-        # localhost multi-process tests). Must happen before any device
-        # query; a plain JAX_PLATFORMS env var can be overridden by site
-        # configuration, the config update cannot.
-        platform = os.environ.get("FLASHY_TPU_PLATFORM")
-        if platform:
-            import jax
-            jax.config.update("jax_platforms", platform)
+        # localhost multi-process tests); see utils.pin_platform.
+        if os.environ.get("FLASHY_TPU_PLATFORM"):
+            from .utils import pin_platform
+            pin_platform()
         argv = list(sys.argv[1:] if argv is None else argv)
         if "--help" in argv or "-h" in argv:
             print(self._usage())
