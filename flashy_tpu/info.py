@@ -1,0 +1,70 @@
+# Experiment inspection CLI — the `dora info` role of the absorbed
+# launcher contract: list the XPs under an output root with their
+# signatures, override argv, progress, and last metrics.
+"""`python -m flashy_tpu.info [root]`: list experiments and their status."""
+import argparse
+import json
+from pathlib import Path
+
+
+def collect(root: Path):
+    """Yield (sig, config, argv, history) for every XP under root."""
+    xps_dir = root / "xps"
+    if not xps_dir.is_dir():
+        return
+    for folder in sorted(xps_dir.iterdir()):
+        if not folder.is_dir():
+            continue
+        entry = {"sig": folder.name, "cfg": {}, "argv": [], "history": []}
+        config_path = folder / "config.json"
+        if config_path.exists():
+            with open(config_path) as f:
+                entry["cfg"] = json.load(f)
+        history_path = folder / "history.json"
+        if history_path.exists():
+            with open(history_path) as f:
+                entry["history"] = json.load(f)
+        yield entry
+
+
+def format_entry(entry, verbose: bool = False) -> str:
+    history = entry["history"]
+    epochs = len(history)
+    line = f"{entry['sig']}  epochs={epochs}"
+    if history:
+        last = history[-1]
+        parts = []
+        for stage, metrics in last.items():
+            if isinstance(metrics, dict):
+                shown = {k: round(v, 4) for k, v in list(metrics.items())[:4]
+                         if isinstance(v, (int, float))}
+                parts.append(f"{stage}: {shown}")
+        if parts:
+            line += "  " + " | ".join(parts)
+    if verbose:
+        line += "\n  cfg: " + json.dumps(entry["cfg"], default=str)[:500]
+    return line
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flashy_tpu.info",
+        description="List flashy_tpu experiments under an output root.")
+    parser.add_argument("root", nargs="?", default="./outputs",
+                        help="output root (the folder containing xps/)")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="also print each XP's config")
+    args = parser.parse_args(argv)
+
+    found = False
+    for entry in collect(Path(args.root)):
+        found = True
+        print(format_entry(entry, verbose=args.verbose))
+    if not found:
+        print(f"no experiments under {args.root}/xps")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,24 @@
+# Tests for the XP inspection CLI.
+from flashy_tpu.info import collect, format_entry, main
+from flashy_tpu.xp import create_xp
+
+
+def test_info_lists_xps(tmp_path, capsys):
+    xp = create_xp({"lr": 0.1}, root=tmp_path)
+    xp.link.update_history([{"train": {"loss": 0.5, "duration": 1.0}}])
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert xp.sig in out and "epochs=1" in out and "loss" in out
+
+
+def test_info_empty_root(tmp_path, capsys):
+    assert main([str(tmp_path)]) == 1
+    assert "no experiments" in capsys.readouterr().out
+
+
+def test_collect_and_format(tmp_path):
+    xp = create_xp({"a": 1}, root=tmp_path)
+    xp.link.update_history([{"valid": {"acc": 0.91}}])
+    (entry,) = collect(tmp_path)
+    line = format_entry(entry, verbose=True)
+    assert "valid" in line and "cfg" in line

@@ -102,3 +102,16 @@ def test_logger_utils():
     assert logger_utils.flatten_dict({"a": {"b": 1}}) == {"a/b": 1}
     out = logger_utils.sanitize_params({"v": np.float32(1.5), "obj": object()})
     assert out["v"] == 1.5 and isinstance(out["obj"], str)
+
+
+def test_wandb_backend_noops_when_missing(xp):
+    # wandb is not installed in CI; init_wandb must warn and no-op, not
+    # crash — the soft-dependency contract.
+    from flashy_tpu.logging import ResultLogger
+    import logging as _logging
+    results = ResultLogger(_logging.getLogger("t"))
+    results.init_wandb()
+    backend = results._experiment_loggers["wandb"]
+    backend.log_metrics("train", {"loss": 1.0}, step=1)
+    backend.log_text("train", "note", "hello", step=1)
+    assert backend.save_dir is not None
