@@ -8,7 +8,9 @@ from pathlib import Path
 
 
 def collect(root: Path):
-    """Yield (sig, config, argv, history) for every XP under root."""
+    """Yield {sig, cfg, argv, history} for every XP under root."""
+    from .xp import CONFIG_SNAPSHOT_NAME, RUN_INFO_NAME, Link
+
     xps_dir = root / "xps"
     if not xps_dir.is_dir():
         return
@@ -16,28 +18,31 @@ def collect(root: Path):
         if not folder.is_dir():
             continue
         entry = {"sig": folder.name, "cfg": {}, "argv": [], "history": []}
-        config_path = folder / "config.json"
+        config_path = folder / CONFIG_SNAPSHOT_NAME
         if config_path.exists():
             with open(config_path) as f:
                 entry["cfg"] = json.load(f)
-        history_path = folder / "history.json"
-        if history_path.exists():
-            with open(history_path) as f:
-                entry["history"] = json.load(f)
+        run_info_path = folder / RUN_INFO_NAME
+        if run_info_path.exists():
+            with open(run_info_path) as f:
+                entry["argv"] = json.load(f).get("argv", [])
+        entry["history"] = Link(folder).load()
         yield entry
 
 
 def format_entry(entry, verbose: bool = False) -> str:
     history = entry["history"]
-    epochs = len(history)
-    line = f"{entry['sig']}  epochs={epochs}"
+    line = f"{entry['sig']}  epochs={len(history)}"
+    if entry["argv"]:
+        line += "  [" + " ".join(entry["argv"]) + "]"
     if history:
         last = history[-1]
         parts = []
         for stage, metrics in last.items():
             if isinstance(metrics, dict):
-                shown = {k: round(v, 4) for k, v in list(metrics.items())[:4]
-                         if isinstance(v, (int, float))}
+                numeric = [(k, v) for k, v in metrics.items()
+                           if isinstance(v, (int, float))]
+                shown = {k: round(v, 4) for k, v in numeric[:4]}
                 parts.append(f"{stage}: {shown}")
         if parts:
             line += "  " + " | ".join(parts)

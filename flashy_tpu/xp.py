@@ -37,6 +37,12 @@ AnyPath = tp.Union[str, Path]
 # files (examples/cifar/config/config.yaml:12-14) work unchanged.
 _META_SECTIONS = ("xp", "dora")
 
+# Canonical file names inside an XP folder (single source of truth for
+# everything that reads the layout, incl. flashy_tpu.info).
+HISTORY_NAME = "history.json"
+CONFIG_SNAPSHOT_NAME = "config.json"
+RUN_INFO_NAME = "run.json"
+
 
 class Config(dict):
     """A nested dict with attribute access, the config object solvers see.
@@ -161,7 +167,7 @@ class Link:
 
     @property
     def history_path(self) -> Path:
-        return self.folder / "history.json"
+        return self.folder / HISTORY_NAME
 
     def load(self) -> tp.List[tp.Dict[str, tp.Any]]:
         if self.history_path.exists():
@@ -192,7 +198,11 @@ class XP:
 
     @property
     def config_snapshot_path(self) -> Path:
-        return self.folder / "config.json"
+        return self.folder / CONFIG_SNAPSHOT_NAME
+
+    @property
+    def run_info_path(self) -> Path:
+        return self.folder / RUN_INFO_NAME
 
     def save_config_snapshot(self) -> None:
         from .distrib import is_rank_zero
@@ -202,6 +212,8 @@ class XP:
         # collide on the temp path.
         with write_and_rename(self.config_snapshot_path, "w", pid=True) as f:
             json.dump(self.cfg, f, indent=2, default=str)
+        with write_and_rename(self.run_info_path, "w", pid=True) as f:
+            json.dump({"argv": self.argv}, f, indent=2)
 
     @contextmanager
     def enter(self):

@@ -34,3 +34,44 @@ def mesh8():
     """2x2x2x1 mesh (data x fsdp x tensor x seq) over the 8 CPU devices."""
     from flashy_tpu.parallel import make_mesh
     return make_mesh({"data": 2, "fsdp": 2, "tensor": 2, "seq": 1})
+
+
+def spawn_workers(script_path, num_workers, timeout=600):
+    """Launch `num_workers` copies of a worker script that rendezvous via
+    jax.distributed on localhost; returns [(exit_code, stderr), ...].
+
+    Shared by the multi-process test suites. Uses communicate() (not
+    wait) so a chatty worker can never deadlock on a full stderr pipe,
+    and kills all workers if any hangs.
+    """
+    import socket
+    import subprocess as sp
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update({
+            "FLASHY_TPU_COORDINATOR": f"localhost:{port}",
+            "FLASHY_TPU_NUM_PROCESSES": str(num_workers),
+            "FLASHY_TPU_PROCESS_ID": str(rank),
+            "PYTHONPATH": os.pathsep.join(
+                [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+                + env.get("PYTHONPATH", "").split(os.pathsep)),
+        })
+        procs.append(sp.Popen([sys.executable, str(script_path)], env=env,
+                              stderr=sp.PIPE, text=True))
+    results = []
+    try:
+        for p in procs:
+            _, err = p.communicate(timeout=timeout)
+            results.append((p.returncode, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return results

@@ -4,14 +4,11 @@
 # jax.distributed on localhost CPU and assert the collectives compute
 # exactly what a single process would. Runs 4 workers to keep CI time
 # sane; the semantics don't depend on the count.
-import os
-import socket
-import subprocess as sp
-import sys
 import textwrap
 
-import numpy as np
 import pytest
+
+from .conftest import spawn_workers
 
 NUM_WORKERS = 4
 
@@ -76,30 +73,10 @@ WORKER_SCRIPT = textwrap.dedent("""
 """)
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
-
-
 @pytest.mark.slow
 def test_multiprocess_collectives(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER_SCRIPT)
-    port = _free_port()
-    procs = []
-    for rank in range(NUM_WORKERS):
-        env = dict(os.environ)
-        env.update({
-            "FLASHY_TPU_COORDINATOR": f"localhost:{port}",
-            "FLASHY_TPU_NUM_PROCESSES": str(NUM_WORKERS),
-            "FLASHY_TPU_PROCESS_ID": str(rank),
-            "PYTHONPATH": os.pathsep.join(
-                [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
-                + os.environ.get("PYTHONPATH", "").split(os.pathsep)),
-        })
-        procs.append(sp.Popen([sys.executable, str(script)], env=env,
-                              stderr=sp.PIPE, text=True))
-    results = [(p.wait(timeout=600), p.stderr.read()) for p in procs]
+    results = spawn_workers(script, NUM_WORKERS)
     for rank, (code, err) in enumerate(results):
         assert code == 0, f"worker {rank} failed:\n{err[-2000:]}"

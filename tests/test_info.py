@@ -22,3 +22,10 @@ def test_collect_and_format(tmp_path):
     (entry,) = collect(tmp_path)
     line = format_entry(entry, verbose=True)
     assert "valid" in line and "cfg" in line
+
+
+def test_info_shows_argv(tmp_path, capsys):
+    xp = create_xp({"lr": 0.5}, root=tmp_path, argv=["lr=0.5"])
+    xp.link.update_history([])
+    assert main([str(tmp_path)]) == 0
+    assert "lr=0.5" in capsys.readouterr().out

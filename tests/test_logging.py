@@ -104,9 +104,11 @@ def test_logger_utils():
     assert out["v"] == 1.5 and isinstance(out["obj"], str)
 
 
-def test_wandb_backend_noops_when_missing(xp):
+def test_wandb_backend_noops_when_missing(xp, monkeypatch):
     # wandb is not installed in CI; init_wandb must warn and no-op, not
-    # crash — the soft-dependency contract.
+    # crash — the soft-dependency contract. If wandb IS installed,
+    # disable any network/auth so the test stays hermetic.
+    monkeypatch.setenv("WANDB_MODE", "disabled")
     from flashy_tpu.logging import ResultLogger
     import logging as _logging
     results = ResultLogger(_logging.getLogger("t"))
