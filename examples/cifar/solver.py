@@ -87,9 +87,11 @@ class Solver(flashy_tpu.BaseSolver):
         return step
 
     def get_formatter(self, stage_name):
-        return flashy_tpu.Formatter({"acc": ".1%", "loss": ".5f"})
+        return flashy_tpu.Formatter({"acc": ".1%", "loss": ".5f",
+                                     "images_per_sec": ".0f"})
 
     def _run_epoch(self, train: bool):
+        import time
         loader = self.loaders["train" if train else "valid"]
         loader.set_epoch(self.epoch)
         step_fn = self._train_step if train else self._eval_step
@@ -97,6 +99,7 @@ class Solver(flashy_tpu.BaseSolver):
         progress = self.log_progress(self.current_stage, loader, updates=5)
         metrics = {}
         count = 0
+        begin = time.time()
         batches = prefetch_to_device(progress, size=2, mesh=self.mesh,
                                      batch_axes=("data",))
         for index, batch in enumerate(batches):
@@ -106,6 +109,8 @@ class Solver(flashy_tpu.BaseSolver):
             metrics = average(step_metrics, weight=len(batch["label"]))
             progress.update(**metrics)
             count += len(batch["label"])
+        jax.block_until_ready(self.state["params"])
+        metrics["images_per_sec"] = count / max(time.time() - begin, 1e-9)
         if not train:
             self.log_image("valid", "sample",
                            np.asarray(jax.device_get(batch["image"][0])) * 0.25 + 0.5)

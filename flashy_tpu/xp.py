@@ -275,7 +275,7 @@ def get_xp_from_sig(sig: str, root: tp.Optional[AnyPath] = None) -> XP:
     env_dir = os.environ.get("FLASHY_TPU_DIR") or os.environ.get("_FLASHY_TMDIR")
     folder_root = Path(root or env_dir or "./outputs")
     folder = folder_root / "xps" / sig
-    snapshot = folder / "config.json"
+    snapshot = folder / CONFIG_SNAPSHOT_NAME
     if not snapshot.exists():
         raise FileNotFoundError(f"No XP with sig {sig} under {folder_root}")
     with open(snapshot) as f:
@@ -386,6 +386,14 @@ def _spawn_workers(num_workers: int, argv: tp.List[str]) -> None:
     child_argv = [a for a in argv
                   if not (a.startswith("--workers=") or a.startswith("--ddp_workers=")
                           or a == "--clear")]
+    # Re-exec exactly how we were launched: `python -m pkg.mod` entry
+    # points (relative imports!) must be respawned with -m, not by
+    # script path.
+    main_spec = getattr(sys.modules.get("__main__"), "__spec__", None)
+    if main_spec is not None and main_spec.name:
+        command = [sys.executable, "-m", main_spec.name] + child_argv
+    else:
+        command = [sys.executable, sys.argv[0]] + child_argv
     for process_id in range(num_workers):
         env = dict(os.environ)
         env.update({
@@ -393,7 +401,7 @@ def _spawn_workers(num_workers: int, argv: tp.List[str]) -> None:
             "FLASHY_TPU_NUM_PROCESSES": str(num_workers),
             "FLASHY_TPU_PROCESS_ID": str(process_id),
         })
-        procs.append(subprocess.Popen([sys.executable, sys.argv[0]] + child_argv, env=env))
+        procs.append(subprocess.Popen(command, env=env))
     codes = [p.wait() for p in procs]
     for process_id, code in enumerate(codes):
         if code != 0:

@@ -40,19 +40,23 @@ def spawn_workers(script_path, num_workers, timeout=600):
     """Launch `num_workers` copies of a worker script that rendezvous via
     jax.distributed on localhost; returns [(exit_code, stderr), ...].
 
-    Shared by the multi-process test suites. Uses communicate() (not
-    wait) so a chatty worker can never deadlock on a full stderr pipe,
-    and kills all workers if any hangs.
+    Shared by the multi-process test suites. Worker stderr goes to temp
+    files (no pipes, so a chatty worker can never block on a full pipe
+    while a sibling is being drained); on timeout every worker is
+    killed and whatever stderr was captured is still returned.
     """
     import socket
     import subprocess as sp
     import sys
+    import tempfile
+    import time
 
     with socket.socket() as s:
         s.bind(("", 0))
         port = s.getsockname()[1]
 
     procs = []
+    err_files = []
     for rank in range(num_workers):
         env = dict(os.environ)
         env.update({
@@ -63,15 +67,31 @@ def spawn_workers(script_path, num_workers, timeout=600):
                 [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
                 + env.get("PYTHONPATH", "").split(os.pathsep)),
         })
+        err_file = tempfile.NamedTemporaryFile("w+", suffix=f".worker{rank}.err",
+                                               delete=False)
+        err_files.append(err_file)
         procs.append(sp.Popen([sys.executable, str(script_path)], env=env,
-                              stderr=sp.PIPE, text=True))
-    results = []
+                              stderr=err_file, text=True))
+
+    deadline = time.time() + timeout
     try:
         for p in procs:
-            _, err = p.communicate(timeout=timeout)
-            results.append((p.returncode, err))
+            remaining = max(deadline - time.time(), 1.0)
+            try:
+                p.wait(timeout=remaining)
+            except sp.TimeoutExpired:
+                break
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+                p.wait()
+
+    results = []
+    for p, err_file in zip(procs, err_files):
+        err_file.flush()
+        err_file.seek(0)
+        results.append((p.returncode, err_file.read()))
+        err_file.close()
+        os.unlink(err_file.name)
     return results

@@ -117,3 +117,10 @@ def test_wandb_backend_noops_when_missing(xp, monkeypatch):
     backend.log_metrics("train", {"loss": 1.0}, step=1)
     backend.log_text("train", "note", "hello", step=1)
     assert backend.save_dir is not None
+
+
+def test_logger_utils_doctests():
+    import doctest
+    import flashy_tpu.loggers.utils as module
+    results = doctest.testmod(module)
+    assert results.failed == 0 and results.attempted > 0
