@@ -103,3 +103,22 @@ def test_sharded_sampler_tiny_dataset_no_empty_shards():
     lengths = [len(list(s)) for s in samplers]
     assert lengths == [1] * 8
     assert all(0 <= i < 3 for s in samplers for i in s)
+
+
+def test_grain_dataset_compatible():
+    # grain MapDatasets satisfy the __len__/__getitem__ protocol our
+    # DataLoader consumes, so grain pipelines plug in directly.
+    grain = __import__("grain.python", fromlist=["python"])
+
+    class Source:
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return {"x": np.full(3, i, np.float32)}
+
+    dataset = grain.MapDataset.source(Source()).map(lambda s: {"x": s["x"] * 2})
+    loader = DataLoader(dataset, batch_size=5, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0]["x"][3], np.full(3, 6.0))

@@ -1,0 +1,59 @@
+# Subprocess smoke tests for every shipped example — the user-facing
+# entry points themselves, driven exactly as a user would (CLI module
+# execution, config overrides), on tiny budgets.
+import json
+import os
+import subprocess as sp
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(tmpdir, module, *overrides, timeout=420):
+    env = dict(os.environ)
+    env["_FLASHY_TMDIR"] = str(tmpdir)
+    env["FLASHY_TPU_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    sp.run([sys.executable, "-m", module, "--clear", *overrides],
+           check=True, env=env, timeout=timeout, cwd=REPO)
+
+
+def _history(tmpdir):
+    xps = os.path.join(str(tmpdir), "xps")
+    (sig,) = os.listdir(xps)
+    with open(os.path.join(xps, sig, "history.json")) as f:
+        return json.load(f)
+
+
+@pytest.mark.slow
+def test_basic_example(tmp_path):
+    _run_example(tmp_path, "examples.basic.train", "epochs=3")
+    history = _history(tmp_path)
+    assert len(history) == 3
+    assert history[-1]["train"]["loss"] < history[0]["train"]["loss"]
+
+
+@pytest.mark.slow
+def test_cifar_example(tmp_path):
+    _run_example(tmp_path, "examples.cifar.train", "epochs=1",
+                 "max_batches=2", "batch_size=16")
+    history = _history(tmp_path)
+    assert set(history[0].keys()) == {"train", "valid"}
+    assert "images_per_sec" in history[0]["train"]
+
+
+@pytest.mark.slow
+def test_lm_example(tmp_path):
+    # batch must divide the data axis (8 virtual devices under the
+    # test env's XLA_FLAGS, which the subprocess inherits)
+    _run_example(tmp_path, "examples.lm.solver", "epochs=1",
+                 "steps_per_epoch=2", "batch_size=8", "seq_len=32",
+                 "model.dim=32", "model.num_layers=1", "model.num_heads=2",
+                 "model.vocab_size=64", "model.attention=dense",
+                 "generate_every=1")
+    history = _history(tmp_path)
+    assert "ppl" in history[0]["train"]
+    assert "generate" in history[0]
