@@ -50,6 +50,11 @@ class MoEMLP(nn.Module):
     top_k: int = 1
     capacity_factor: float = 1.25
     dtype: tp.Any = jnp.bfloat16
+    dispatch: str = "einsum"   # 'einsum': one-hot [N,E,C] dispatch whose
+    #   contractions lower to all-to-alls under expert sharding (use on
+    #   expert-parallel meshes); 'sorted': argsort-based scatter/gather,
+    #   O(N) dispatch memory instead of O(N*E*C) (use for large
+    #   token-count, replicated-expert training).
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -59,12 +64,12 @@ class MoEMLP(nn.Module):
         capacity = max(1, int(self.capacity_factor * n_tokens * self.top_k
                               / self.num_experts))
         x_flat = x.reshape(n_tokens, dim)
+        if self.dispatch == "sorted":
+            return self._sorted_moe(x_flat, capacity).reshape(batch, seq, dim)
+        if self.dispatch != "einsum":
+            raise ValueError(f"unknown dispatch {self.dispatch!r}")
 
-        # Router in f32 for stable softmax.
-        router_logits = nn.Dense(self.num_experts, use_bias=False,
-                                 dtype=jnp.float32, name="router")(
-                                     x_flat.astype(jnp.float32))
-        probs = jax.nn.softmax(router_logits, axis=-1)        # [N, E]
+        probs, w_up, w_down = self._router_and_weights(x_flat)  # [N, E]
 
         # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e.
         density = jnp.mean(probs, axis=0)
@@ -104,12 +109,6 @@ class MoEMLP(nn.Module):
         # caller requests mutable=['intermediates']).
         self.sow("intermediates", "dispatch", dispatch)
 
-        # Expert weight tables [E, ...]: shard dim 0 over 'expert'.
-        w_up = self.param("w_up", nn.initializers.lecun_normal(),
-                          (self.num_experts, dim, self.hidden), jnp.float32)
-        w_down = self.param("w_down", nn.initializers.lecun_normal(),
-                            (self.num_experts, self.hidden, dim), jnp.float32)
-
         # Dispatch -> per-expert batches; these einsums become the
         # all-to-alls when x is batch-sharded and w_* expert-sharded.
         expert_in = jnp.einsum("nec,nd->ecd", dispatch,
@@ -119,3 +118,87 @@ class MoEMLP(nn.Module):
         expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
         out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), expert_out)
         return out.reshape(batch, seq, dim)
+
+    def _router_and_weights(self, x_flat: jax.Array):
+        """Single definition of the router (f32 softmax) and the expert
+        weight tables [E, ...] (shard dim 0 over 'expert'); shared by
+        both dispatch modes so their parameter trees stay identical."""
+        probs = jax.nn.softmax(
+            nn.Dense(self.num_experts, use_bias=False, dtype=jnp.float32,
+                     name="router")(x_flat.astype(jnp.float32)), axis=-1)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (self.num_experts, x_flat.shape[-1], self.hidden),
+                          jnp.float32)
+        w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                            (self.num_experts, self.hidden, x_flat.shape[-1]),
+                            jnp.float32)
+        return probs, w_up, w_down
+
+    def _sorted_moe(self, x_flat: jax.Array, capacity: int) -> jax.Array:
+        """Sorted dispatch: identical routing/keep decisions to the
+        einsum path (stable sort preserves token order within an expert,
+        so slot positions match the cumulative-sum assignment), but the
+        buffers are O(N): tokens scatter into per-expert [E*C, D] slabs
+        by computed destination index and gather back out.
+        """
+        n_tokens, dim = x_flat.shape
+        probs, w_up, w_down = self._router_and_weights(x_flat)
+
+        density = jnp.mean(probs, axis=0)
+        hard_density = jnp.zeros_like(density)
+        expert_counts = jnp.zeros((self.num_experts,), jnp.int32)
+        remaining = probs
+        # Route every top-k round first; the per-round slot offsets
+        # (expert_counts) make the destinations disjoint, so all rounds
+        # share ONE slab and the expert MLP runs once.
+        slab = jnp.zeros((self.num_experts * capacity, dim), self.dtype)
+        rounds = []
+        for _ in range(self.top_k):
+            expert_index = jnp.argmax(remaining, axis=-1)          # [N]
+            gate = jnp.take_along_axis(
+                remaining, expert_index[:, None], axis=-1)[:, 0]
+            hard_density = hard_density + jnp.mean(
+                jax.nn.one_hot(expert_index, self.num_experts), axis=0)
+
+            order = jnp.argsort(expert_index, stable=True)
+            idx_sorted = expert_index[order]
+            # first sorted position of each expert's group
+            starts = jnp.searchsorted(idx_sorted, jnp.arange(self.num_experts))
+            pos_in_expert = (jnp.arange(n_tokens) - starts[idx_sorted]
+                             + expert_counts[idx_sorted])
+            keep = pos_in_expert < capacity
+            # OOB destination for dropped tokens; scatter mode='drop'
+            # discards them, gather mode='fill' zeroes them.
+            dest = jnp.where(keep, idx_sorted * capacity + pos_in_expert,
+                             self.num_experts * capacity)
+            slab = slab.at[dest].set(x_flat[order].astype(self.dtype),
+                                     mode="drop")
+            rounds.append((order, dest, gate[order] * keep))
+
+            expert_counts = expert_counts + jnp.bincount(
+                jnp.where(keep, idx_sorted, self.num_experts),
+                length=self.num_experts + 1)[:-1].astype(jnp.int32)
+            remaining = remaining * (1.0 - jax.nn.one_hot(
+                expert_index, self.num_experts))
+
+        # Routing record for tests/debugging (cf. the einsum path's
+        # 'dispatch' sow): destinations per round, stacked [top_k, N].
+        self.sow("intermediates", "dispatch_dest",
+                 jnp.stack([dest for _, dest, _ in rounds]))
+
+        h = jnp.einsum("ecd,edf->ecf",
+                       slab.reshape(self.num_experts, capacity, dim),
+                       w_up.astype(self.dtype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
+        flat_out = expert_out.reshape(self.num_experts * capacity, dim)
+
+        out = jnp.zeros((n_tokens, dim), jnp.float32)
+        for order, dest, gate_kept in rounds:
+            y_sorted = flat_out.at[dest].get(
+                mode="fill", fill_value=0).astype(jnp.float32)
+            out = out + (y_sorted * gate_kept[:, None])[jnp.argsort(order)]
+
+        aux = self.num_experts * jnp.sum(density * hard_density / self.top_k)
+        self.sow("losses", "moe_aux", aux)
+        return out.astype(self.dtype)
