@@ -29,15 +29,40 @@ class StateDictSource(tp.Protocol):
         ...
 
 
+def _capture(value: tp.Any) -> StateDict:
+    """Snapshot a value: protocol objects export themselves, plain values
+    are stored as-is (the checkpoint layer copies device arrays to host)."""
+    return value.state_dict() if isinstance(value, StateDictSource) else value
+
+
+def _restore(owner: tp.Any, attr: str, payload: StateDict) -> None:
+    """Put `payload` back into `owner.<attr>`.
+
+    Mutable containers and protocol objects are refilled in place so that
+    aliases held elsewhere keep seeing the restored content; any other
+    value — numbers, strings, JAX pytrees (immutable) — is rebound with
+    `setattr`, which is exactly right for functional state.
+    """
+    current = getattr(owner, attr)
+    if isinstance(current, StateDictSource):
+        current.load_state_dict(payload)
+        return
+    if isinstance(current, list):
+        current[:] = payload
+        return
+    if isinstance(current, dict):
+        current.clear()
+        current.update(payload)
+        return
+    setattr(owner, attr, payload)
+
+
 class AttributeWrapper:
     """Expose an arbitrary attribute of `owner` as a StateDictSource.
 
     Restore dispatch (reference flashy/state.py:39-49): protocol match →
     in-place `load_state_dict`; list → slice assign; dict → clear+update;
-    anything else → `setattr`. JAX pytrees (tuples of arrays, optax
-    states, flax FrozenDicts) are immutable values and take the `setattr`
-    path, which is exactly right: the attribute is rebound to the restored
-    tree.
+    anything else → `setattr`.
     """
 
     def __init__(self, owner: tp.Any, name: str):
@@ -45,22 +70,10 @@ class AttributeWrapper:
         self.name = name
 
     def state_dict(self) -> StateDict:
-        attr = getattr(self.owner, self.name)
-        if isinstance(attr, StateDictSource):
-            return attr.state_dict()
-        return attr
+        return _capture(getattr(self.owner, self.name))
 
     def load_state_dict(self, state: StateDict) -> None:
-        attr = getattr(self.owner, self.name)
-        if isinstance(attr, StateDictSource):
-            attr.load_state_dict(state)
-        elif isinstance(attr, list):
-            attr[:] = state
-        elif isinstance(attr, dict):
-            attr.clear()
-            attr.update(state)
-        else:
-            setattr(self.owner, self.name, state)
+        _restore(self.owner, self.name, state)
 
 
 class WriteOnlyWrapper(StateDictSource):
@@ -78,7 +91,10 @@ class WriteOnlyWrapper(StateDictSource):
         return self.source.state_dict()
 
     def load_state_dict(self, state: StateDict) -> None:
-        return None
+        del state  # forensic-only entry: restoring is a deliberate no-op
+
+    def __repr__(self) -> str:
+        return f"WriteOnlyWrapper({self.source!r})"
 
 
 class StateManager(StateDictSource):
@@ -89,14 +105,21 @@ class StateManager(StateDictSource):
 
     def register(self, name: str, source: StateDictSource, write_only: bool = False) -> None:
         if name in self.sources:
-            raise ValueError(f"{name} already present in sources.")
-        if write_only:
-            source = WriteOnlyWrapper(source)
-        self.sources[name] = source
+            raise ValueError(
+                f"A stateful entry named {name!r} is already registered; "
+                "pick a distinct name per register_stateful call.")
+        self.sources[name] = WriteOnlyWrapper(source) if write_only else source
+
+    def names(self) -> tp.List[str]:
+        """Registered entry names, in registration order."""
+        return list(self.sources)
 
     def state_dict(self) -> StateDict:
-        return {name: source.state_dict() for name, source in self.sources.items()}
+        out: tp.Dict[str, StateDict] = {}
+        for name, source in self.sources.items():
+            out[name] = source.state_dict()
+        return out
 
     def load_state_dict(self, state: StateDict) -> None:
-        for name, sub_state in state.items():
-            self.sources[name].load_state_dict(sub_state)
+        for name, payload in state.items():
+            self.sources[name].load_state_dict(payload)
