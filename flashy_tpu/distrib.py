@@ -84,6 +84,22 @@ def init(backend: tp.Optional[str] = None) -> None:
     # else: single process, nothing to do.
 
 
+def _launcher_rank_world() -> tp.Optional[tp.Tuple[int, int]]:
+    """(rank, world_size) from the launcher environment, or None.
+
+    Only trusts an env var set when the *complete* set `init()` would act
+    on is present: a stale `RANK=3` left over from an unrelated torchrun
+    (without MASTER_ADDR/WORLD_SIZE) must not make `is_rank_zero()` False
+    on a plain single-process run — that would silently disable history
+    and checkpoint writes.
+    """
+    if _env("FLASHY_TPU_COORDINATOR") and _env("FLASHY_TPU_NUM_PROCESSES"):
+        return int(_env("FLASHY_TPU_PROCESS_ID") or 0), int(_env("FLASHY_TPU_NUM_PROCESSES"))
+    if _env("MASTER_ADDR") and _env("WORLD_SIZE"):
+        return int(_env("RANK") or 0), int(_env("WORLD_SIZE"))
+    return None
+
+
 def rank() -> int:
     """Process index, available even before `init()`.
 
@@ -93,18 +109,18 @@ def rank() -> int:
     (the reference had the same concern: rank pre-init via
     dora.distrib.get_distrib_spec, flashy/logging.py:66-68).
     """
-    pid = _env("FLASHY_TPU_PROCESS_ID", "RANK")
-    if pid is not None:
-        return int(pid)
+    from_env = _launcher_rank_world()
+    if from_env is not None:
+        return from_env[0]
     if _initialized or jax.distributed.is_initialized():
         return jax.process_index()
     return 0
 
 
 def world_size() -> int:
-    num = _env("FLASHY_TPU_NUM_PROCESSES", "WORLD_SIZE")
-    if num is not None:
-        return int(num)
+    from_env = _launcher_rank_world()
+    if from_env is not None:
+        return from_env[1]
     if _initialized or jax.distributed.is_initialized():
         return jax.process_count()
     return 1

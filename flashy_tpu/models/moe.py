@@ -80,23 +80,27 @@ class MoEMLP(nn.Module):
         remaining = probs
         # Slots already handed out per expert by earlier top-k rounds, so
         # a second-choice token never collides with a first-choice one.
-        expert_counts = jnp.zeros((self.num_experts,), jnp.float32)
+        # All slot bookkeeping is integer: a float32 cumsum loses exact
+        # integer positions past 2^24 routed tokens, silently colliding
+        # capacity slots on very large global batches.
+        expert_counts = jnp.zeros((self.num_experts,), jnp.int32)
         for _ in range(self.top_k):
             expert_index = jnp.argmax(remaining, axis=-1)      # [N]
             gate = jnp.take_along_axis(
                 remaining, expert_index[:, None], axis=-1)[:, 0]
-            mask = jax.nn.one_hot(expert_index, self.num_experts)  # [N, E]
-            hard_density = hard_density + jnp.mean(mask, axis=0)
+            mask = jax.nn.one_hot(expert_index, self.num_experts,
+                                  dtype=jnp.int32)                 # [N, E]
+            hard_density = hard_density + jnp.mean(
+                mask.astype(jnp.float32), axis=0)
             # Position of each token inside its expert's buffer, offset
             # by the slots used in previous rounds.
-            position = ((jnp.cumsum(mask, axis=0) - 1.0)
+            position = ((jnp.cumsum(mask, axis=0) - 1)
                         + expert_counts[None, :]) * mask           # [N, E]
-            within = position < capacity
+            within = (position < capacity).astype(jnp.int32)
             mask = mask * within
-            slot = jax.nn.one_hot(position.sum(axis=-1).astype(jnp.int32),
-                                  capacity)                        # [N, C]
-            combine = combine + gate[:, None, None] * mask[:, :, None] \
-                * slot[:, None, :]
+            slot = jax.nn.one_hot(position.sum(axis=-1), capacity)  # [N, C]
+            combine = combine + gate[:, None, None] \
+                * mask.astype(jnp.float32)[:, :, None] * slot[:, None, :]
             expert_counts = expert_counts + mask.sum(axis=0)
             remaining = remaining * (1.0 - jax.nn.one_hot(
                 expert_index, self.num_experts))

@@ -87,7 +87,8 @@ def shard_params(params: tp.Any, mesh: tp.Optional[Mesh] = None,
 
 
 def with_grad_accumulation(value_and_grad_fn: tp.Callable,
-                           num_microbatches: int) -> tp.Callable:
+                           num_microbatches: int, *,
+                           fold_rng: bool = True) -> tp.Callable:
     """Split the batch into microbatches and accumulate gradients.
 
     Wraps `value_and_grad_fn(params, batch, *rest) -> (loss, grads)`
@@ -98,10 +99,32 @@ def with_grad_accumulation(value_and_grad_fn: tp.Callable,
 
         grad_fn = with_grad_accumulation(jax.value_and_grad(loss_fn), 8)
 
-    The batch's leading dim must divide by `num_microbatches`.
+    The batch's leading dim must divide by `num_microbatches`. With
+    `fold_rng=True` (default), any PRNG key found among `rest` has the
+    microbatch index folded in, so dropout (etc.) draws fresh randomness
+    per microbatch instead of repeating the same pattern
+    `num_microbatches` times. Typed keys (`jax.random.key`) are detected
+    exactly; legacy raw keys are detected as uint32 arrays of shape (2,)
+    — if you pass a NON-key uint32 pair through `rest`, set
+    `fold_rng=False` (or switch to typed keys) to avoid it being
+    misread as a key and rewritten.
     """
     if num_microbatches <= 1:
         return value_and_grad_fn
+
+    def fold_rng_keys(tree, index):
+        if not fold_rng:
+            return tree
+
+        def fold(leaf):
+            dtype = getattr(leaf, "dtype", None)
+            if dtype is None:
+                return leaf
+            is_key = jnp.issubdtype(dtype, jax.dtypes.prng_key) or (
+                dtype == jnp.uint32 and getattr(leaf, "shape", None) == (2,))
+            return jax.random.fold_in(leaf, index) if is_key else leaf
+
+        return jax.tree_util.tree_map(fold, tree)
 
     def wrapped(params, batch, *rest):
         def split(x):
@@ -110,15 +133,19 @@ def with_grad_accumulation(value_and_grad_fn: tp.Callable,
 
         micro = jax.tree_util.tree_map(split, batch)
 
-        def body(carry, microbatch):
+        def body(carry, inputs):
+            index, microbatch = inputs
             loss_acc, grad_acc = carry
-            loss, grads = value_and_grad_fn(params, microbatch, *rest)
+            loss, grads = value_and_grad_fn(params, microbatch,
+                                            *fold_rng_keys(rest, index))
             grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
             return (loss_acc + loss, grad_acc), None
 
         zeros = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, _grad_dtype(p)), params)
-        (loss, grads), _ = jax.lax.scan(body, (jnp.zeros(()), zeros), micro)
+        (loss, grads), _ = jax.lax.scan(
+            body, (jnp.zeros(()), zeros),
+            (jnp.arange(num_microbatches), micro))
         scale = 1.0 / num_microbatches
         return loss * scale, jax.tree_util.tree_map(
             lambda g: g * scale, grads)
@@ -173,7 +200,12 @@ def wrap(step_fn: tp.Optional[tp.Callable] = None, *,
     compiled_cache: tp.Dict[tp.Any, tp.Callable] = {}
 
     def wrapped(state, batch, *rest):
-        key = jax.tree_util.tree_structure(state)
+        # Key on structure AND leaf shapes/dtypes: resolved shardings
+        # depend on leaf shapes (fsdp picks the dim to split), so a state
+        # with the same structure but different shapes must not reuse them.
+        key = (jax.tree_util.tree_structure(state),
+               tuple((tuple(np.shape(leaf)), str(getattr(leaf, "dtype", type(leaf))))
+                     for leaf in jax.tree_util.tree_leaves(state)))
         if key not in compiled_cache:
             sharding = resolve_state_sharding(state)
             # `None` legs leave the sharding to the partitioner (prefix
