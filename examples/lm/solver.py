@@ -44,19 +44,20 @@ class LMSolver(flashy_tpu.BaseSolver):
     def __init__(self, cfg):
         super().__init__()
         self.cfg = cfg
+        self.pipe_stages = int(cfg.mesh.get("pipe", 1))
+        # Pipeline parallelism streams the scan-stacked block params
+        # over the 'pipe' axis (models/pipelined.py), so pipe>1 forces
+        # the stacked layout.
+        scan_layers = bool(cfg.model.get("scan_layers", False)) or self.pipe_stages > 1
         model_cfg = TransformerConfig(
             vocab_size=cfg.model.vocab_size, dim=cfg.model.dim,
             num_layers=cfg.model.num_layers, num_heads=cfg.model.num_heads,
             mlp_ratio=cfg.model.mlp_ratio, attention=cfg.model.attention,
             remat=cfg.model.get("remat", False),
+            scan_layers=scan_layers,
             moe_experts=cfg.model.get("moe_experts", 0),
             moe_top_k=cfg.model.get("moe_top_k", 1),
             moe_capacity_factor=cfg.model.get("moe_capacity_factor", 1.25))
-        if cfg.mesh.get("pipe", 1) > 1:
-            raise ValueError(
-                "examples.lm does not pipeline the block stack; mesh.pipe>1 "
-                "would silently replicate compute. Use "
-                "flashy_tpu.parallel.pipeline for stage-stacked models.")
         self.mesh = make_mesh({k: v for k, v in cfg.mesh.items()})
         self.model = TransformerLM(model_cfg, mesh=self.mesh)
 
@@ -89,10 +90,8 @@ class LMSolver(flashy_tpu.BaseSolver):
         opt_state = jax.jit(self.optim.init)(params)
         self.state = {"params": params, "opt_state": opt_state,
                       "step": jnp.zeros((), jnp.int32)}
-        # Remember every leaf's sharding so a restored (host numpy) state
-        # can be placed back onto the mesh exactly as it was.
-        self._state_shardings = jax.tree_util.tree_map(
-            lambda x: x.sharding, self.state)
+        # restore() re-places every restored leaf onto the live state's
+        # shardings automatically — no hand-rolled device_put needed.
         self.register_stateful("state")
 
         self._stream = synthetic_token_stream(cfg.model.vocab_size)
@@ -101,9 +100,18 @@ class LMSolver(flashy_tpu.BaseSolver):
 
         moe = model_cfg.moe_experts > 0
         aux_weight = cfg.model.get("moe_aux_weight", 0.01)
+        pipe_stages = self.pipe_stages
+        pipe_micro = cfg.get("pipeline_microbatches", None)
+        mesh = self.mesh
 
         def loss_fn(variables, tokens):
-            if moe:
+            if pipe_stages > 1:
+                from flashy_tpu.models import pipelined_apply
+                out = pipelined_apply(model, variables, tokens, mesh=mesh,
+                                      num_microbatches=pipe_micro)
+                logits, aux = out if moe else (out, 0.0)
+                aux = aux_weight * aux if moe else 0.0
+            elif moe:
                 from flashy_tpu.models import moe_aux_loss
                 logits, mutated = model.apply(variables, tokens,
                                               mutable=["losses"])
@@ -175,16 +183,8 @@ class LMSolver(flashy_tpu.BaseSolver):
 
     def run(self):
         restored = self.restore()
-        if restored:
-            self.state = jax.tree_util.tree_map(
-                jax.device_put, self.state, self._state_shardings)
         self.logger.info("Restored: %s; starting at epoch %d", restored, self.epoch)
         want_generate = bool(self.cfg.get("generate_every"))
-        if want_generate and self.cfg.model.get("moe_experts", 0) > 0:
-            self.logger.warning(
-                "generate stage disabled: cached decoding does not support "
-                "MoE models yet")
-            want_generate = False
         for epoch in range(self.epoch, self.cfg.epochs + 1):
             self.run_stage("train", self.train)
             if want_generate and epoch % self.cfg.generate_every == 0:

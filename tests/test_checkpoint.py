@@ -148,3 +148,94 @@ def test_import_flashy_checkpoint_unflattens_dotted_keys():
     # '0.weight' -> nested {'0': {'weight': ...}}
     assert imported["model"]["0"]["weight"].shape == (8, 4)
     assert imported["model"]["1"]["bias"].shape == (2,)
+
+
+def test_place_like_restores_shardings():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from flashy_tpu.checkpoint import place_like
+    from flashy_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"fsdp": 4, "data": 2})
+    sh = NamedSharding(mesh, P("fsdp", None))
+    live = {"params": {"w": jax.device_put(jnp.ones((8, 4)), sh)},
+            "step": 3, "note": "x"}
+    restored = {"params": {"w": np.full((8, 4), 2.0, np.float32)},
+                "step": 7, "note": "y"}
+    placed = place_like(live, restored)
+    assert isinstance(placed["params"]["w"], jax.Array)
+    assert placed["params"]["w"].sharding == sh
+    np.testing.assert_allclose(np.asarray(placed["params"]["w"]), 2.0)
+    assert placed["step"] == 7 and placed["note"] == "y"
+
+
+def test_place_like_tolerates_mismatch():
+    import jax
+    from flashy_tpu.checkpoint import place_like
+    # shape mismatch -> restored value kept as-is; missing template -> kept
+    live = {"w": jnp.ones((4,)), "extra": None}
+    restored = {"w": np.ones((8,), np.float32), "new": 5}
+    out = place_like(live, restored)
+    assert isinstance(out["w"], np.ndarray) and out["w"].shape == (8,)
+    assert out["new"] == 5
+
+
+def test_place_like_optax_namedtuple():
+    import jax
+    from flashy_tpu.checkpoint import place_like
+
+    params = {"w": jnp.ones(3)}
+    opt = optax.adam(1e-3)
+    live = opt.init(params)
+    host = jax.tree_util.tree_map(lambda x: np.asarray(x), live)
+    placed = place_like(live, host)
+    assert type(placed) is type(live)
+    leaves = jax.tree_util.tree_leaves(placed)
+    import jax as _jax
+    assert all(isinstance(x, _jax.Array) or np.isscalar(x) for x in leaves)
+
+
+def test_sharded_state_roundtrip_with_placements(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from flashy_tpu.checkpoint import (load_state_sharded, save_state_sharded,
+                                       sharded_checkpoint_exists)
+    from flashy_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"fsdp": 4, "data": 2})
+    sh = NamedSharding(mesh, P("fsdp", None))
+    state = {
+        "state": {"params": {"w": jax.device_put(
+            jnp.arange(32.0).reshape(8, 4), sh)},
+            "step": jnp.int32(5)},
+        "history": [{"train": {"loss": 1.5}}],
+        "xp.cfg": {"lr": 0.1},
+    }
+    directory = tmp_path / "ckpt.sharded"
+    assert not sharded_checkpoint_exists(directory)
+    save_state_sharded(state, directory)
+    assert sharded_checkpoint_exists(directory)
+
+    placements = {"state": state["state"]}
+    restored = load_state_sharded(directory, placements)
+    w = restored["state"]["params"]["w"]
+    assert isinstance(w, jax.Array) and w.sharding == sh
+    np.testing.assert_allclose(np.asarray(w), np.arange(32.0).reshape(8, 4))
+    assert int(restored["state"]["step"]) == 5
+    assert restored["history"] == [{"train": {"loss": 1.5}}]
+    assert restored["xp.cfg"] == {"lr": 0.1}
+
+
+def test_sharded_ab_slots_survive_next_save(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from flashy_tpu.checkpoint import (_read_slot_pointer, load_state_sharded,
+                                       save_state_sharded)
+
+    directory = tmp_path / "ckpt.sharded"
+    save_state_sharded({"v": jnp.float32(1.0)}, directory)
+    first_slot = _read_slot_pointer(directory)
+    save_state_sharded({"v": jnp.float32(2.0)}, directory)
+    second_slot = _read_slot_pointer(directory)
+    assert first_slot != second_slot  # alternating slots
+    assert float(np.asarray(load_state_sharded(directory)["v"])) == 2.0
