@@ -5,14 +5,18 @@
 #
 #  * `dot_product_attention` — plain XLA implementation; correct
 #    everywhere, O(T^2) memory. XLA already fuses the softmax chain.
-#  * `flash_attention` — pallas TPU kernel: tiles Q/K/V blocks through
+#  * `flash_attention` — pallas TPU kernels: tiles Q/K/V blocks through
 #    VMEM with the online-softmax recurrence so the TxT score matrix
-#    never hits HBM. Forward is the pallas kernel; backward is a
-#    custom-vjp recompute in XLA (O(T^2) memory — use sequence
-#    parallelism via flashy_tpu.parallel.ring_attention for sequences
-#    where that matters).
+#    never hits HBM, in forward AND backward. The forward kernel also
+#    emits the per-row logsumexp; the backward recomputes P blockwise
+#    from it in two kernels (dQ with K-blocks innermost; dK/dV with
+#    Q-blocks innermost), so training memory is O(T) in the sequence —
+#    the FlashAttention-2 decomposition, laid out for the MXU.
 #
 # Array convention: [batch, time, heads, head_dim] (flax-style).
+# The logsumexp rows are carried broadcast across a 128-wide lane dim
+# ([BH, T, 128]) — the layout the public TPU kernels use, native to the
+# f32 vector tile.
 """Attention: XLA reference implementation + pallas flash kernel."""
 import functools
 import typing as tp
@@ -45,15 +49,41 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # pallas flash attention (TPU)
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, causal: bool, block_q: int, block_k: int,
+LANES = 128  # native f32 lane width; row-stat tensors ride it
+
+
+def _causal_visible(qi, ki, block_q: int, block_k: int, offset: int):
+    """Whether k-block `ki` holds any key visible to q-block `qi`."""
+    return ki * block_k <= qi * block_q + block_q - 1 + offset
+
+
+def _block_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k,
+                  offset):
+    """Recompute the masked score block [block_q, block_k] on the MXU."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        scores = jnp.where(q_pos + offset >= k_pos, scores, NEG_INF)
+    return scores
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                  *, scale: float, causal: bool, block_q: int, block_k: int,
                   offset: int):
-    """One (batch*head, q-block, k-block) grid step.
+    """Forward: one (batch*head, q-block, k-block) grid step.
 
     The TPU grid iterates the last dimension fastest, so for a fixed
     q-block the k-blocks arrive sequentially and the VMEM scratch
     (running max / normalizer / accumulator) carries the online-softmax
-    state across them. Output is written on the final k-block.
+    state across them. Output and the per-row logsumexp (the backward's
+    softmax residual) are written on the final k-block.
 
     `offset = t_k - t_q` aligns causal masking bottom-right (query i
     attends keys j <= i + offset), matching `dot_product_attention`'s
@@ -71,19 +101,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     qi = pl.program_id(1)
 
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)          # [block_q, D]
-        k = k_ref[0].astype(jnp.float32)          # [block_k, D]
+        scores = _block_scores(q_ref, k_ref, qi, ki, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, offset=offset)
         v = v_ref[0].astype(jnp.float32)          # [block_k, D]
-        scores = jax.lax.dot_general(             # [block_q, block_k] on MXU
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            scores = jnp.where(q_pos + offset >= k_pos, scores, NEG_INF)
 
         m_prev = m_scr[:, 0]                       # [block_q]
         block_max = scores.max(axis=-1)
@@ -102,8 +123,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     if causal:
         # Fully-future blocks contribute nothing; skip their MXU work
         # entirely (roughly halves causal attention FLOPs).
-        visible = ki * block_k <= qi * block_q + block_q - 1 + offset
-        pl.when(visible)(_accumulate)
+        pl.when(_causal_visible(qi, ki, block_q, block_k, offset))(_accumulate)
     else:
         _accumulate()
 
@@ -111,6 +131,101 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finalize():
         denom = jnp.maximum(l_scr[:, 0], 1e-30)
         o_ref[0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+        # logsumexp of each score row; rows with no visible key (can only
+        # happen for padding layouts) would be -inf, clamp via denom.
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     dq_scr, *, scale: float, causal: bool, block_q: int,
+                     block_k: int, offset: int):
+    """Backward dQ: grid (batch*head, q-block, k-block), k innermost.
+
+    For a fixed q-block, k-blocks stream by while the dQ accumulator
+    lives in VMEM; P is recomputed from the forward's logsumexp (no TxT
+    residual). dS = P * (dP - D) with D = rowsum(dO*O) precomputed.
+    """
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _accumulate():
+        scores = _block_scores(q_ref, k_ref, qi, ki, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, offset=offset)
+        lse = lse_ref[0, :, 0][:, None]            # [block_q, 1]
+        probs = jnp.exp(scores - lse)              # [block_q, block_k]
+        do = do_ref[0].astype(jnp.float32)         # [block_q, D]
+        v = v_ref[0].astype(jnp.float32)           # [block_k, D]
+        dp = jax.lax.dot_general(                  # dO V^T [block_q, block_k]
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        delta = delta_ref[0, :, 0][:, None]        # [block_q, 1]
+        ds = probs * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_causal_visible(qi, ki, block_q, block_k, offset))(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                      causal: bool, block_q: int, block_k: int, offset: int):
+    """Backward dK/dV: grid (batch*head, k-block, q-block), q innermost.
+
+    For a fixed k-block, q-blocks stream by accumulating
+    dV += P^T dO and dK += dS^T Q in VMEM.
+    """
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _accumulate():
+        scores = _block_scores(q_ref, k_ref, qi, ki, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, offset=offset)
+        lse = lse_ref[0, :, 0][:, None]
+        probs = jnp.exp(scores - lse)              # [block_q, block_k]
+        do = do_ref[0].astype(jnp.float32)         # [block_q, D]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(   # P^T dO [block_k, D]
+            probs, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        delta = delta_ref[0, :, 0][:, None]
+        ds = probs * (dp - delta) * scale          # [block_q, block_k]
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(   # dS^T Q [block_k, D]
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_causal_visible(qi, ki, block_q, block_k, offset))(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 try:  # pallas import is cheap but keep the module importable everywhere
@@ -121,20 +236,31 @@ except Exception:  # pragma: no cover
     _PALLAS_AVAILABLE = False
 
 
+def _fold(x: jax.Array) -> jax.Array:
+    """[B, T, H, D] -> [B*H, T, D] (batch and heads become the grid axis)."""
+    batch, t, heads, dim = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(batch * heads, t, dim)
+
+
+def _unfold(x: jax.Array, batch: int, heads: int) -> jax.Array:
+    """[B*H, T, D] -> [B, T, H, D]."""
+    _, t, dim = x.shape
+    return x.reshape(batch, heads, t, dim).transpose(0, 2, 1, 3)
+
+
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
-                   block_q: int, block_k: int, interpret: bool) -> jax.Array:
+                   block_q: int, block_k: int, interpret: bool):
+    """Returns (out [B,T,H,D], lse [B*H, T, LANES])."""
     batch, t_q, heads, dim = q.shape
     t_k = k.shape[1]
     scale = 1.0 / np.sqrt(dim)
-    # Fold batch and heads into the leading grid axis: [B*H, T, D].
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(batch * heads, x.shape[1], dim)
-    qf, kf, vf = fold(q), fold(k), fold(v)
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
 
     grid = (batch * heads, t_q // block_q, t_k // block_k)
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
                                offset=t_k - t_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -142,37 +268,109 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
             pl.BlockSpec((1, block_k, dim), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, dim), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((batch * heads, t_q, dim), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * heads, t_q, dim), q.dtype),
+            jax.ShapeDtypeStruct((batch * heads, t_q, LANES), jnp.float32),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer
-            pltpu.VMEM((block_q, dim), jnp.float32),  # output accumulator
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running normalizer
+            pltpu.VMEM((block_q, dim), jnp.float32),    # output accumulator
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(batch, heads, t_q, dim).transpose(0, 2, 1, 3)
+    return _unfold(out, batch, heads), lse
+
+
+def _flash_backward(q, k, v, out, lse, grad_out, *, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
+    batch, t_q, heads, dim = q.shape
+    t_k = k.shape[1]
+    scale = 1.0 / np.sqrt(dim)
+    offset = t_k - t_q
+    bh = batch * heads
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    dof = _fold(grad_out)
+
+    # D = rowsum(dO * O): cheap elementwise+reduce, leave it to XLA; the
+    # kernels read it broadcast over the lane dim like the lse.
+    delta = jnp.sum(_fold(grad_out).astype(jnp.float32)
+                    * _fold(out).astype(jnp.float32), axis=-1)   # [BH, T_q]
+    delta = jnp.broadcast_to(delta[:, :, None], (bh, t_q, LANES))
+
+    row_specs = [
+        pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),    # q
+        pl.BlockSpec((1, block_k, dim), lambda b, qi, ki: (b, ki, 0)),    # k
+        pl.BlockSpec((1, block_k, dim), lambda b, qi, ki: (b, ki, 0)),    # v
+        pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),    # dO
+        pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),  # lse
+        pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),  # D
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, offset=offset),
+        grid=(bh, t_q // block_q, t_k // block_k),
+        in_specs=row_specs,
+        out_specs=pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, dim), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dim), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    col_specs = [
+        pl.BlockSpec((1, block_q, dim), lambda b, ki, qi: (b, qi, 0)),    # q
+        pl.BlockSpec((1, block_k, dim), lambda b, ki, qi: (b, ki, 0)),    # k
+        pl.BlockSpec((1, block_k, dim), lambda b, ki, qi: (b, ki, 0)),    # v
+        pl.BlockSpec((1, block_q, dim), lambda b, ki, qi: (b, qi, 0)),    # dO
+        pl.BlockSpec((1, block_q, LANES), lambda b, ki, qi: (b, qi, 0)),  # lse
+        pl.BlockSpec((1, block_q, LANES), lambda b, ki, qi: (b, qi, 0)),  # D
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, offset=offset),
+        grid=(bh, t_k // block_k, t_q // block_q),
+        in_specs=col_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, dim), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_k, dim), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, dim), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dim), jnp.float32),
+            pltpu.VMEM((block_k, dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (_unfold(dq, batch, heads), _unfold(dk, batch, heads),
+            _unfold(dv, batch, heads))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal=causal, block_q=block_q,
-                          block_k=block_k, interpret=interpret)
+    out, _ = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                            block_k=block_k, interpret=interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                              block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, grad_out):
-    # Recompute-based backward through the XLA reference implementation:
-    # identical math, O(T^2) memory. For long sequences shard T over the
-    # mesh instead (parallel.ring_attention).
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: dot_product_attention(q, k, v, causal=causal),
-                     q, k, v)
-    return vjp(grad_out)
+    q, k, v, out, lse = residuals
+    return _flash_backward(q, k, v, out, lse, grad_out, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
 
 
 if _PALLAS_AVAILABLE:
@@ -185,9 +383,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     interpret: tp.Optional[bool] = None) -> jax.Array:
     """Flash attention over [B, T, H, D]; pallas on TPU, XLA elsewhere.
 
-    Falls back to `dot_product_attention` when pallas cannot run (non-TPU
-    backend without interpret mode) or when T is not divisible by the
-    block sizes. Block sizes are clamped to the sequence length.
+    Forward and backward are pallas kernels (O(T) sequence memory; the
+    backward recomputes P blockwise from the forward's logsumexp — the
+    FlashAttention-2 decomposition). Falls back to
+    `dot_product_attention` when pallas cannot run (non-TPU backend
+    without interpret mode) or when T is not divisible by the block
+    sizes. Block sizes are clamped to the sequence length.
     """
     t_q, t_k = q.shape[1], k.shape[1]
     block_q = min(block_q, t_q)

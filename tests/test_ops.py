@@ -78,3 +78,57 @@ def test_flash_causal_cross_length_matches_dense():
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_flash_backward_cross_length():
+    # gradients with t_q != t_k through the pallas backward kernels
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.normal(size=(1, 32, 2, 16)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 64, 2, 16)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(1, 64, 2, 16)).astype(np.float32))
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    flash = loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=16, block_k=32))
+    dense = loss(lambda q, k, v: dot_product_attention(q, k, v, causal=True))
+    ga = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+    gb = jax.grad(dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(ga, gb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_backward_asymmetric_blocks_non_causal():
+    q, k, v = _rand_qkv((2, 64, 2, 32), seed=7)
+
+    def flash_loss(q, k, v):
+        return (flash_attention(q, k, v, causal=False,
+                                block_q=32, block_k=64) ** 3).sum()
+
+    def dense_loss(q, k, v):
+        return (dot_product_attention(q, k, v, causal=False) ** 3).sum()
+
+    ga = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    gb = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(ga, gb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_backward_bf16_dtype_and_close():
+    q, k, v = _rand_qkv((1, 64, 2, 16), seed=8)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def flash_loss(q, k, v):
+        return (flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+                .astype(jnp.float32) ** 2).sum()
+
+    grads = jax.grad(flash_loss, argnums=(0, 1, 2))(qb, kb, vb)
+    assert all(g.dtype == jnp.bfloat16 for g in grads)
+    ref = jax.grad(lambda q, k, v: (dot_product_attention(
+        q, k, v, causal=True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(grads, ref):
+        np.testing.assert_allclose(np.asarray(a, dtype=np.float32),
+                                   np.asarray(b), rtol=0.1, atol=0.05)
