@@ -5,6 +5,13 @@
 # this). TPU-first: static shapes throughout (cache laid out at
 # max_len), one fused scan instead of a python token loop, greedy or
 # temperature/top-k sampling.
+#
+# All TransformerLM layouts decode here: per-layer parameter trees
+# (block_i), scan-stacked models (stacked [L, ...] params — the cache is
+# stacked too and the layer loop is a lax.scan), and MoE blocks (routed
+# dropless at decode time: every token sees its top-k experts; capacity
+# buffers are a *training* batching artifact with no meaning for
+# autoregressive decoding).
 """KV-cache decoding: generate(model, params, prompt, ...) -> tokens."""
 import typing as tp
 
@@ -19,8 +26,17 @@ def _split_heads(qkv: jax.Array) -> tp.Tuple[jax.Array, jax.Array, jax.Array]:
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> tp.Dict:
-    """Allocate the static-shape KV cache for every layer."""
+    """Allocate the static-shape KV cache.
+
+    Per-layer models get one {'k','v'} entry per block; scan-stacked
+    models get single stacked [L, B, T, H, Dh] arrays (the layer dim is
+    scanned together with the stacked parameters).
+    """
     shape = (batch, max_len, cfg.num_heads, cfg.head_dim)
+    if cfg.scan_layers:
+        stacked = (cfg.num_layers,) + shape
+        return {"k": jnp.zeros(stacked, cfg.dtype),
+                "v": jnp.zeros(stacked, cfg.dtype)}
     return {
         f"block_{i}": {
             "k": jnp.zeros(shape, cfg.dtype),
@@ -30,55 +46,154 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> tp.Dict:
     }
 
 
-def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
-                positions: jax.Array, cache: tp.Dict, cache_index: jax.Array):
-    """Forward `tokens` [B, S] at `positions`, reading+writing the cache.
+# Above this many tokens, per-token expert-weight gathers ([N, D, F]
+# buffers) dominate memory; switch to streaming over experts instead.
+_MOE_GATHER_MAX_TOKENS = 64
 
-    Re-implements the block stack against cached K/V (the training
-    module computes full-sequence attention; decoding attends to the
-    cache prefix). Weights are read from the same parameter tree.
+
+def _moe_forward(cfg: TransformerConfig, mp: tp.Dict, x: jax.Array) -> jax.Array:
+    """Dropless routed MoE for decoding: [B, S, D] -> [B, S, D].
+
+    Matches MoEMLP's routing math (f32 softmax router, raw-probability
+    gates, sequential top-k argmax) but without capacity buffers — exact
+    for every token, no overflow drops. Two equivalent evaluation
+    orders: single-token decode steps gather each token's expert weights
+    directly (tiny N); the prefill streams over the experts under a
+    lax.scan, computing every token against one expert's weights at a
+    time (peak extra memory N*F, never N*D*F).
     """
-    p = params["params"]
-    x = jnp.take(p["embed"], tokens, axis=0).astype(cfg.dtype)
-    batch, seq = tokens.shape
-    new_cache = {}
-    for layer in range(cfg.num_layers):
-        bp = p[f"block_{layer}"]
-        normed = _rmsnorm(x, bp["norm1"]["scale"], cfg.dtype)
-        qkv = jnp.einsum("btd,dchk->btchk", normed,
-                         bp["attn"]["qkv"]["kernel"].astype(cfg.dtype))
-        q, k, v = _split_heads(qkv)
-        q = _rotary(q, positions)
-        k = _rotary(k, positions)
-        layer_cache = cache[f"block_{layer}"]
-        k_cache = jax.lax.dynamic_update_slice(
-            layer_cache["k"], k.astype(cfg.dtype), (0, cache_index, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            layer_cache["v"], v.astype(cfg.dtype), (0, cache_index, 0, 0))
-        new_cache[f"block_{layer}"] = {"k": k_cache, "v": v_cache}
+    batch, seq, dim = x.shape
+    n_tokens = batch * seq
+    x_flat = x.reshape(n_tokens, dim)
+    logits = x_flat.astype(jnp.float32) @ mp["router"]["kernel"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)            # [N, E]
+    num_experts = probs.shape[-1]
 
-        # Attend over the cache prefix [0, cache_index + seq).
-        max_len = k_cache.shape[1]
-        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
-                            preferred_element_type=jnp.float32) * scale
-        key_pos = jnp.arange(max_len)[None, :]
-        query_pos = positions[:, :, None]  # [B, S, 1] global positions
-        mask = key_pos[None] <= query_pos  # causal over the cache
-        scores = jnp.where(mask[:, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v_cache)
-        attn_out = jnp.einsum("bqhd,hdD->bqD", attn,
-                              bp["attn"]["out"]["kernel"].astype(cfg.dtype))
-        x = x + attn_out
+    # Combined per-(token, expert) gate over the top-k rounds.
+    combine = jnp.zeros_like(probs)
+    remaining = probs
+    for _ in range(cfg.moe_top_k):
+        expert_index = jnp.argmax(remaining, axis=-1)  # [N]
+        gate = jnp.take_along_axis(remaining, expert_index[:, None],
+                                   axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(expert_index, num_experts)
+        combine = combine + gate[:, None] * onehot
+        remaining = remaining * (1.0 - onehot)
 
-        normed = _rmsnorm(x, bp["norm2"]["scale"], cfg.dtype)
+    w_up = mp["w_up"]                                  # [E, D, F]
+    w_down = mp["w_down"]                              # [E, F, D]
+
+    if n_tokens <= _MOE_GATHER_MAX_TOKENS:
+        # Token-gather order: one [N, D, F] gather per used slot.
+        out = jnp.zeros_like(x_flat, dtype=jnp.float32)
+        remaining = probs
+        for _ in range(cfg.moe_top_k):
+            expert_index = jnp.argmax(remaining, axis=-1)
+            gate = jnp.take_along_axis(remaining, expert_index[:, None],
+                                       axis=-1)[:, 0]
+            up = jnp.take(w_up, expert_index, axis=0).astype(cfg.dtype)
+            down = jnp.take(w_down, expert_index, axis=0).astype(cfg.dtype)
+            h = jax.nn.gelu(jnp.einsum("nd,ndf->nf",
+                                       x_flat.astype(cfg.dtype), up))
+            y = jnp.einsum("nf,nfd->nd", h, down)
+            out = out + gate[:, None] * y.astype(jnp.float32)
+            remaining = remaining * (1.0 - jax.nn.one_hot(
+                expert_index, num_experts))
+    else:
+        # Expert-stream order (prefill): every expert transforms the
+        # full token set once; the combine gate (zero for unrouted
+        # pairs) weights the sum. Identical result — f_e is linear in
+        # its weighting — without per-token weight copies.
+        x_c = x_flat.astype(cfg.dtype)
+
+        def body(out, expert_in):
+            up, down, gates = expert_in          # [D,F], [F,D], [N]
+            h = jax.nn.gelu(x_c @ up.astype(cfg.dtype))
+            y = h @ down.astype(cfg.dtype)
+            return out + gates[:, None] * y.astype(jnp.float32), None
+
+        out, _ = jax.lax.scan(
+            body, jnp.zeros_like(x_flat, dtype=jnp.float32),
+            (w_up, w_down, combine.T))
+
+    return out.reshape(batch, seq, dim).astype(cfg.dtype)
+
+
+def _layer_forward(cfg: TransformerConfig, bp: tp.Dict, x: jax.Array,
+                   positions: jax.Array, k_cache: jax.Array,
+                   v_cache: jax.Array, cache_index: jax.Array):
+    """One block against cached K/V: returns (x, k_cache, v_cache)."""
+    normed = _rmsnorm(x, bp["norm1"]["scale"], cfg.dtype)
+    qkv = jnp.einsum("btd,dchk->btchk", normed,
+                     bp["attn"]["qkv"]["kernel"].astype(cfg.dtype))
+    q, k, v = _split_heads(qkv)
+    q = _rotary(q, positions)
+    k = _rotary(k, positions)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(cfg.dtype), (0, cache_index, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(cfg.dtype), (0, cache_index, 0, 0))
+
+    # Attend over the cache prefix [0, cache_index + seq).
+    max_len = k_cache.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    key_pos = jnp.arange(max_len)[None, :]
+    query_pos = positions[:, :, None]  # [B, S, 1] global positions
+    mask = key_pos[None] <= query_pos  # causal over the cache
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v_cache)
+    attn_out = jnp.einsum("bqhd,hdD->bqD", attn,
+                          bp["attn"]["out"]["kernel"].astype(cfg.dtype))
+    x = x + attn_out
+
+    normed = _rmsnorm(x, bp["norm2"]["scale"], cfg.dtype)
+    if "moe" in bp:
+        x = x + _moe_forward(cfg, bp["moe"], normed)
+    else:
         up = jnp.einsum("btd,df->btf", normed,
                         bp["mlp"]["up"]["kernel"].astype(cfg.dtype))
         gate, value = jnp.split(up, 2, axis=-1)
         mlp_out = jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * value,
                              bp["mlp"]["down"]["kernel"].astype(cfg.dtype))
         x = x + mlp_out
+    return x, k_cache, v_cache
+
+
+def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
+                positions: jax.Array, cache: tp.Dict, cache_index: jax.Array):
+    """Forward `tokens` [B, S] at `positions`, reading+writing the cache.
+
+    Re-implements the block stack against cached K/V (the training
+    module computes full-sequence attention; decoding attends to the
+    cache prefix). Weights are read from the same parameter tree; the
+    scan-stacked layout runs the layer loop as a lax.scan over the
+    stacked params + stacked cache.
+    """
+    p = params["params"]
+    x = jnp.take(p["embed"], tokens, axis=0).astype(cfg.dtype)
+    if cfg.scan_layers:
+        stacked = p["blocks"]["block"]  # every leaf has leading [L]
+
+        def body(x, layer_in):
+            bp, k_c, v_c = layer_in
+            x, k_c, v_c = _layer_forward(cfg, bp, x, positions, k_c, v_c,
+                                         cache_index)
+            return x, (k_c, v_c)
+
+        x, (k_cache, v_cache) = jax.lax.scan(
+            body, x, (stacked, cache["k"], cache["v"]))
+        new_cache = {"k": k_cache, "v": v_cache}
+    else:
+        new_cache = {}
+        for layer in range(cfg.num_layers):
+            name = f"block_{layer}"
+            x, k_cache, v_cache = _layer_forward(
+                cfg, p[name], x, positions,
+                cache[name]["k"], cache[name]["v"], cache_index)
+            new_cache[name] = {"k": k_cache, "v": v_cache}
 
     x = _rmsnorm(x, p["norm_f"]["scale"], cfg.dtype)
     logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
@@ -92,9 +207,9 @@ def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
     """Autoregressive generation with a KV cache.
 
     Args:
-        model: a TransformerLM (its config drives shapes). MoE and ring
-            attention models are not supported in the cached decode path
-            yet — use dense/flash training attention variants.
+        model: a TransformerLM (its config drives shapes). All layer
+            layouts are supported: per-layer params, scan-stacked, and
+            MoE blocks (decoded dropless — see `_moe_forward`).
         params: the model's variables ({'params': ...}).
         prompt: [B, P] int32 prompt tokens.
         max_new_tokens: tokens to append.
@@ -106,12 +221,6 @@ def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
     static in P and max_new_tokens.
     """
     cfg: TransformerConfig = model.config
-    if cfg.moe_experts > 0:
-        raise NotImplementedError("cached decoding with MoE not supported yet")
-    if cfg.scan_layers:
-        raise NotImplementedError(
-            "cached decoding reads per-layer params (block_i); "
-            "scan-stacked models are not supported yet")
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
