@@ -15,19 +15,24 @@ from .transformer import Block, TransformerLM, rmsnorm as _rmsnorm
 
 def pipelined_apply(model: TransformerLM, variables: tp.Mapping,
                     tokens: jax.Array, *, mesh=None,
-                    num_microbatches: tp.Optional[int] = None) -> jax.Array:
+                    num_microbatches: tp.Optional[int] = None):
     """Forward a scan-stacked TransformerLM with pipeline parallelism.
 
     Requirements: `config.scan_layers=True`, `num_layers` divisible by
-    the mesh's 'pipe' size, no dropout (eval-mode blocks) and no MoE
-    (sown aux losses cannot cross the pipeline boundary yet). Gradients
+    the mesh's 'pipe' size, no dropout (eval-mode blocks). Gradients
     flow: wrap in jax.grad for pipelined training.
+
+    Returns logits, or `(logits, moe_aux)` for MoE models: the sown
+    per-layer load-balancing losses are summed inside each pipeline
+    stage and across microbatches, then averaged over microbatches —
+    each microbatch computes its own router densities, so the value is
+    the mean of per-microbatch aux losses rather than the single
+    full-batch aux of the unpipelined path (same estimator, averaged
+    over smaller token sets; the expert *outputs* are unaffected).
     """
     cfg = model.config
     if not cfg.scan_layers:
         raise ValueError("pipelined_apply needs TransformerConfig.scan_layers=True")
-    if cfg.moe_experts > 0:
-        raise NotImplementedError("pipelined_apply does not support MoE yet")
     from ..parallel.mesh import default_mesh
     mesh = mesh or default_mesh()
     num_stages = mesh.shape["pipe"]
@@ -35,6 +40,7 @@ def pipelined_apply(model: TransformerLM, variables: tp.Mapping,
         raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
                          f"pipe={num_stages}")
     layers_per_stage = cfg.num_layers // num_stages
+    moe = cfg.moe_experts > 0
 
     params = variables["params"]
     embedding = params["embed"]
@@ -53,14 +59,34 @@ def pipelined_apply(model: TransformerLM, variables: tp.Mapping,
             jnp.arange(h.shape[1], dtype=jnp.int32)[None, :], h.shape[:2])
 
         def body(carry, layer_params):
+            if moe:
+                out, mutated = block.apply(
+                    {"params": layer_params}, carry, positions,
+                    mutable=["losses"])
+                from .moe import moe_aux_loss
+                return out, moe_aux_loss(mutated)
             out = block.apply({"params": layer_params}, carry, positions)
             return out, None
 
-        h, _ = jax.lax.scan(body, h, local_params)
+        h, aux = jax.lax.scan(body, h, local_params)
+        if moe:
+            return h, jnp.sum(aux)
         return h
 
-    x = pipeline(stage_fn, stage_params, x, mesh=mesh,
-                 num_microbatches=num_microbatches)
+    result = pipeline(stage_fn, stage_params, x, mesh=mesh,
+                      num_microbatches=num_microbatches, has_aux=moe)
+    if moe:
+        x, aux_sum = result
+        num_micro = num_microbatches or num_stages
+        if num_stages == 1:
+            num_micro = 1  # degenerate path runs the full batch at once
+        aux = aux_sum / num_micro
+    else:
+        x = result
+
     x = _rmsnorm(x, params["norm_f"]["scale"], cfg.dtype)
-    return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), embedding,
-                      preferred_element_type=jnp.float32)
+    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), embedding,
+                        preferred_element_type=jnp.float32)
+    if moe:
+        return logits, aux
+    return logits

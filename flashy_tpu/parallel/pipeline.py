@@ -15,12 +15,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _stage_body(stage_fn, params, x_micro, axis, num_stages, num_micro):
+def _stage_body(stage_fn, params, x_micro, axis, num_stages, num_micro,
+                has_aux):
     """Per-device schedule; runs under shard_map with `axis` bound.
 
     x_micro: [M, mb, ...] microbatched input (replicated over `axis`).
-    Returns this stage's outputs [M, mb, ...]; only the LAST stage's
-    leg holds the pipeline's result.
+    Returns (outputs [1, M, mb, ...], aux [1]): only the LAST stage's
+    output leg holds the pipeline's result; aux is this stage's summed
+    auxiliary scalar over its valid (stage, microbatch) ticks.
     """
     stage = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
@@ -34,12 +36,21 @@ def _stage_body(stage_fn, params, x_micro, axis, num_stages, num_micro):
     outputs0 = jnp.zeros_like(x_micro)
 
     def tick(carry, t):
-        incoming, outputs = carry
+        incoming, outputs, aux_sum = carry
         # Stage 0 injects microbatch t (clamped; masked when t >= M).
         fresh = jax.lax.dynamic_index_in_dim(
             x_micro, jnp.clip(t, 0, num_micro - 1), keepdims=False)
         x_in = jnp.where(stage == 0, fresh, incoming)
-        y = stage_fn(params, x_in)
+        if has_aux:
+            y, aux = stage_fn(params, x_in)
+            # Stage s works on microbatch t - s at tick t; count its aux
+            # only when that microbatch index is real (fill/drain ticks
+            # run on garbage activations).
+            micro_index = t - stage
+            valid = jnp.logical_and(micro_index >= 0, micro_index < num_micro)
+            aux_sum = aux_sum + jnp.where(valid, aux.astype(jnp.float32), 0.0)
+        else:
+            y = stage_fn(params, x_in)
         # Last stage banks its result at output slot t - (S-1).
         slot = t - (num_stages - 1)
         write = jnp.logical_and(stage == num_stages - 1, slot >= 0)
@@ -50,27 +61,34 @@ def _stage_body(stage_fn, params, x_micro, axis, num_stages, num_micro):
             lambda o: o, outputs)
         # Ship activations one hop down the ring.
         incoming = jax.lax.ppermute(y, axis, perm)
-        return (incoming, outputs), None
+        return (incoming, outputs, aux_sum), None
 
-    (_, outputs), _ = jax.lax.scan(tick, (zero, outputs0), jnp.arange(ticks))
-    return outputs[None]  # leading stage dim for out_specs=P(axis)
+    aux0 = jax.lax.pcast(jnp.zeros(()), (axis,), to="varying")
+    (_, outputs, aux_sum), _ = jax.lax.scan(
+        tick, (zero, outputs0, aux0), jnp.arange(ticks))
+    return outputs[None], aux_sum[None]  # leading stage dim for P(axis)
 
 
 def pipeline(stage_fn: tp.Callable, stage_params: tp.Any, x: jax.Array, *,
              mesh: tp.Optional[Mesh] = None, axis: str = "pipe",
-             num_microbatches: tp.Optional[int] = None) -> jax.Array:
+             num_microbatches: tp.Optional[int] = None,
+             has_aux: bool = False):
     """Run a shape-preserving stage function as a GPipe pipeline.
 
     Args:
         stage_fn: `(params_slice, activations) -> activations`, SAME
             input/output shape (e.g. a stack of transformer blocks).
+            With `has_aux=True`: `-> (activations, aux_scalar)`; the
+            scalars are summed over every stage and microbatch and
+            returned alongside the output (MoE load-balancing losses).
         stage_params: pytree whose leaves have a leading `num_stages`
             dim; stage s uses `leaf[s]`. Shard with `P('pipe', ...)`.
         x: the batch [B, ...], replicated over the 'pipe' axis.
         num_microbatches: how finely to split B (must divide it);
             defaults to the number of stages.
 
-    Returns activations after all stages, shape of `x`.
+    Returns activations after all stages (shape of `x`), or
+    `(activations, aux_total)` with `has_aux=True`.
 
     Differentiable: the whole schedule is lax.scan + ppermute, so
     jax.grad pipelines the backward in reverse automatically.
@@ -80,25 +98,29 @@ def pipeline(stage_fn: tp.Callable, stage_params: tp.Any, x: jax.Array, *,
     num_stages = mesh.shape[axis]
     if num_stages == 1:
         # Degenerate single-stage pipeline: apply the only stage.
-        return stage_fn(jax.tree_util.tree_map(lambda p: p[0], stage_params), x)
+        only = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        return stage_fn(only, x)
     num_micro = num_microbatches or num_stages
     batch = x.shape[0]
     if batch % num_micro:
         raise ValueError(f"batch {batch} not divisible into {num_micro} microbatches")
     x_micro = x.reshape(num_micro, batch // num_micro, *x.shape[1:])
 
-    body = functools.partial(_stage_body, stage_fn, axis=axis,
-                             num_stages=num_stages, num_micro=num_micro)
+    body = functools.partial(_stage_body, axis=axis, num_stages=num_stages,
+                             num_micro=num_micro, has_aux=has_aux)
 
     # params sharded on their stacked leading dim; input replicated over
     # 'pipe'. Output comes back stacked over stages; the last stage's
-    # slice is the pipeline result.
-    out_stacked = jax.shard_map(
+    # slice is the pipeline result, the aux scalars sum over stages.
+    out_stacked, aux_stacked = jax.shard_map(
         lambda params, xm: body(
-            jax.tree_util.tree_map(lambda p: p[0], params), xm),
+            stage_fn, jax.tree_util.tree_map(lambda p: p[0], params), xm),
         mesh=mesh,
         in_specs=(P(axis), P()),
-        out_specs=P(axis),
+        out_specs=(P(axis), P(axis)),
     )(stage_params, x_micro)
     out = out_stacked[-1]  # [M, mb, ...] from the final stage
-    return out.reshape(batch, *x.shape[1:])
+    out = out.reshape(batch, *x.shape[1:])
+    if has_aux:
+        return out, aux_stacked.sum()
+    return out
