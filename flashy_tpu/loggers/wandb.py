@@ -113,10 +113,44 @@ class WandbLogger(ExperimentLogger):
     def name(self) -> str:
         return self._name
 
+    @staticmethod
+    def _lookup_prior_run(sig: str, project: tp.Optional[str]):
+        """Fetch the wandb run previously created for this XP signature.
+
+        The reference re-attaches to the prior run's identity through the
+        public API (flashy/loggers/wandb.py:204-228): group, display name
+        and config are read back so a resumed experiment keeps showing up
+        as the same run. Returns None when unreachable (offline, first
+        run, no wandb login)."""
+        if not _WANDB_AVAILABLE:
+            return None
+        try:
+            api = wandb.Api()
+            return api.run(f"{project}/{sig}" if project else sig)
+        except Exception:  # CommError, no login, offline, first run
+            return None
+
     @classmethod
     def from_xp(cls, with_media_logging: bool = True, name: str = "wandb",
+                project: tp.Optional[str] = None,
                 **kwargs: tp.Any) -> "WandbLogger":
         from ..xp import get_xp
         xp = get_xp()
+        group = kwargs.pop("group", None)
+        run_name = kwargs.pop("run_name", None)
+        # Network lookup only where it can matter: on the writer rank
+        # (other processes never init wandb) and only when the marker
+        # file says a prior run exists — a fresh XP has nothing to fetch
+        # and an offline pod should not stall on HTTP retries per host.
+        prior = None
+        if cls._is_writer_rank() and (Path(xp.folder) / "wandb_flag").exists():
+            prior = cls._lookup_prior_run(xp.sig, project)
+        if prior is not None:
+            group = prior.group
+            run_name = prior.name
+            prior_config = dict(prior.config) if prior.config else None
+            if prior_config is not None and "config" not in kwargs:
+                kwargs["config"] = prior_config
         return cls(str(xp.folder), with_media_logging=with_media_logging,
-                   name=name, run_id=xp.sig, **kwargs)
+                   name=name, project=project, group=group,
+                   run_id=xp.sig, run_name=run_name, **kwargs)

@@ -124,3 +124,68 @@ def test_logger_utils_doctests():
     import flashy_tpu.loggers.utils as module
     results = doctest.testmod(module)
     assert results.failed == 0 and results.attempted > 0
+
+
+def test_wandb_resume_reuses_prior_run_identity(xp, monkeypatch):
+    # Mocked-API resume fidelity (reference flashy/loggers/wandb.py:204-228):
+    # a resumed XP must query the API for the prior run and reuse its
+    # group / display name / config, with the run id pinned to the sig.
+    import types
+    from flashy_tpu.loggers import wandb as wandb_mod
+
+    init_calls = []
+
+    class FakePriorRun:
+        group = "prior-group"
+        name = "prior-name"
+        config = {"lr": 0.25}
+
+    class FakeApi:
+        def run(self, path):
+            assert path == f"proj/{xp.sig}"
+            return FakePriorRun()
+
+    fake = types.SimpleNamespace(
+        Api=FakeApi,
+        init=lambda **kw: init_calls.append(kw) or types.SimpleNamespace(
+            config=types.SimpleNamespace(update=lambda *a, **k: None),
+            log=lambda *a, **k: None),
+    )
+    monkeypatch.setattr(wandb_mod, "wandb", fake)
+    monkeypatch.setattr(wandb_mod, "_WANDB_AVAILABLE", True)
+
+    # simulate a prior run having started from this XP folder
+    (xp.folder / "wandb_flag").touch()
+
+    backend = wandb_mod.WandbLogger.from_xp(project="proj")
+    assert backend._run is not None
+    (call,) = init_calls
+    assert call["id"] == xp.sig
+    assert call["group"] == "prior-group"
+    assert call["name"] == "prior-name"
+    assert call["config"] == {"lr": 0.25}
+    assert call["resume"] == "allow"
+
+
+def test_wandb_first_run_tolerates_api_failure(xp, monkeypatch):
+    import types
+    from flashy_tpu.loggers import wandb as wandb_mod
+
+    init_calls = []
+
+    class FakeApi:
+        def run(self, path):
+            raise RuntimeError("no such run")
+
+    fake = types.SimpleNamespace(
+        Api=FakeApi,
+        init=lambda **kw: init_calls.append(kw) or types.SimpleNamespace(),
+    )
+    monkeypatch.setattr(wandb_mod, "wandb", fake)
+    monkeypatch.setattr(wandb_mod, "_WANDB_AVAILABLE", True)
+
+    backend = wandb_mod.WandbLogger.from_xp(project="proj")
+    (call,) = init_calls
+    assert call["id"] == xp.sig
+    assert call["group"] is None
+    assert call["resume"] is None  # fresh run, no marker file
