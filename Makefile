@@ -17,7 +17,10 @@ coverage:
 bench:
 	python bench.py
 
+docs:
+	python tools/gendocs.py -o docs/api -p flashy_tpu
+
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests coverage bench dist
+.PHONY: default linter tests coverage bench docs dist

@@ -1,0 +1,151 @@
+# HTML API documentation generator (stdlib-only).
+#
+# Role parity with the reference's docs pipeline — `pdoc3 --html -o docs
+# -f flashy` (reference Makefile:13-14) published by
+# .github/workflows/docs.yml — built on inspect/pydoc because this
+# environment cannot install pdoc. Generates one page per module from
+# the live docstrings + signatures, plus an index.
+"""Generate HTML API docs: python tools/gendocs.py [-o docs/api]."""
+import argparse
+import html
+import importlib
+import inspect
+import pkgutil
+import sys
+from pathlib import Path
+
+# Runnable from a source checkout without installation.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+STYLE = """
+body { font-family: -apple-system, 'Segoe UI', sans-serif; margin: 2em auto;
+       max-width: 60em; padding: 0 1em; color: #1a1a1a; }
+code, pre, .sig { font-family: ui-monospace, 'SFMono-Regular', Menlo, monospace; }
+pre { background: #f6f8fa; padding: .8em; border-radius: 6px; overflow-x: auto; }
+.sig { background: #f6f8fa; padding: .4em .6em; border-radius: 6px;
+       display: block; margin: .3em 0; white-space: pre-wrap; }
+.doc { margin: .4em 0 1.2em 1.5em; white-space: pre-wrap; }
+h1 { border-bottom: 2px solid #eee; padding-bottom: .3em; }
+h2 { margin-top: 1.6em; border-bottom: 1px solid #eee; }
+h3 { margin-bottom: .2em; }
+a { color: #0969da; text-decoration: none; }
+nav { background: #f6f8fa; padding: .6em 1em; border-radius: 6px; }
+.kind { color: #6a737d; font-size: .85em; font-weight: normal; }
+"""
+
+
+def _page(title: str, body: str) -> str:
+    return (f"<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title><style>{STYLE}</style>"
+            f"</head><body>{body}</body></html>")
+
+
+def _doc(obj) -> str:
+    text = inspect.getdoc(obj) or ""
+    return f"<div class='doc'>{html.escape(text)}</div>" if text else ""
+
+
+def _signature(obj) -> str:
+    try:
+        return html.escape(str(inspect.signature(obj)))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def _public_members(module):
+    names = getattr(module, "__all__", None)
+    if names is None:
+        names = [n for n in vars(module) if not n.startswith("_")]
+    out = []
+    for name in names:
+        obj = getattr(module, name, None)
+        if inspect.ismodule(obj):
+            continue
+        # document only what this module defines (not re-exports of deps)
+        owner = getattr(obj, "__module__", None)
+        if owner is not None and not owner.startswith(
+                module.__name__.split(".")[0]):
+            continue
+        out.append((name, obj))
+    return out
+
+
+def _render_class(name: str, cls) -> str:
+    parts = [f"<h3 id='{name}'><span class='kind'>class</span> {name}</h3>"]
+    parts.append(f"<span class='sig'>{name}{_signature(cls)}</span>")
+    parts.append(_doc(cls))
+    for mname, member in inspect.getmembers(cls):
+        if mname.startswith("_") or not (inspect.isfunction(member)
+                                         or inspect.ismethod(member)):
+            continue
+        if member.__qualname__.split(".")[0] != cls.__name__:
+            continue  # inherited
+        parts.append(f"<h4 id='{name}.{mname}'>{name}.{mname}</h4>")
+        parts.append(f"<span class='sig'>{mname}{_signature(member)}</span>")
+        parts.append(_doc(member))
+    return "\n".join(parts)
+
+
+def render_module(module) -> str:
+    name = module.__name__
+    parts = [f"<nav><a href='index.html'>index</a> · {html.escape(name)}</nav>",
+             f"<h1>{html.escape(name)}</h1>", _doc(module)]
+    functions, classes = [], []
+    for mname, obj in _public_members(module):
+        if inspect.isclass(obj):
+            classes.append((mname, obj))
+        elif inspect.isfunction(obj):
+            functions.append((mname, obj))
+    if classes:
+        parts.append("<h2>Classes</h2>")
+        parts += [_render_class(n, c) for n, c in classes]
+    if functions:
+        parts.append("<h2>Functions</h2>")
+        for mname, fn in functions:
+            parts.append(f"<h3 id='{mname}'>{mname}</h3>")
+            parts.append(f"<span class='sig'>{mname}{_signature(fn)}</span>")
+            parts.append(_doc(fn))
+    return _page(name, "\n".join(parts))
+
+
+def iter_modules(package_name: str):
+    package = importlib.import_module(package_name)
+    yield package
+    for info in pkgutil.walk_packages(package.__path__,
+                                      prefix=package_name + "."):
+        if any(part.startswith("_") for part in info.name.split(".")):
+            continue
+        try:
+            yield importlib.import_module(info.name)
+        except Exception as exc:  # soft deps may be absent
+            print(f"skip {info.name}: {exc}", file=sys.stderr)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-o", "--output", default="docs/api")
+    parser.add_argument("-p", "--package", default="flashy_tpu")
+    args = parser.parse_args()
+
+    out = Path(args.output)
+    out.mkdir(parents=True, exist_ok=True)
+    entries = []
+    for module in iter_modules(args.package):
+        page = render_module(module)
+        fname = module.__name__ + ".html"
+        (out / fname).write_text(page)
+        first = (inspect.getdoc(module) or "").split("\n", 1)[0]
+        entries.append((module.__name__, fname, first))
+        print("wrote", out / fname)
+
+    items = "\n".join(
+        f"<li><a href='{fname}'><code>{html.escape(name)}</code></a> — "
+        f"{html.escape(first)}</li>" for name, fname, first in sorted(entries))
+    index = _page(f"{args.package} API",
+                  f"<h1>{args.package} API documentation</h1><ul>{items}</ul>")
+    (out / "index.html").write_text(index)
+    print("wrote", out / "index.html")
+
+
+if __name__ == "__main__":
+    main()
