@@ -1,47 +1,96 @@
-# Benchmark harness. Prints ONE JSON line:
-#   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+# Benchmark harness. Prints ONE JSON line on stdout:
+#   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+# All diagnostics go to stderr; the process exits 0 whenever a number was
+# produced (even on CPU fallback, flagged via extra.platform).
 #
 # Headline (BASELINE.json metric): CIFAR-10 ResNet-18 training
-# throughput in images/sec/chip, measured on whatever accelerator is
-# attached (the driver runs this on one real TPU chip). Measures a
-# representative jitted train step (bf16 NHWC ResNet-18, SGD+momentum,
-# data-parallel mesh over the available devices) fed through the
-# framework's host->device prefetcher over rotating host batches, so
-# input-pipeline cost is included; the full examples.cifar solver adds
-# logging/augmentation on top of this.
+# throughput in images/sec/chip. extra carries the flagship Transformer
+# LM numbers: tokens/sec/chip and MFU (analytic model FLOPs vs the
+# chip's peak bf16 FLOPs), plus backend/platform diagnostics.
 #
-# The reference publishes no numbers (BASELINE.md: "none published"), so
-# vs_baseline is reported against REFERENCE_IMAGES_PER_SEC below — the
+# Backend bring-up is the part that failed in round 1 (the driver's TPU
+# tunnel was down and jax.devices() crashed — BENCH_r01.json rc=1).
+# Now: the TPU backend is probed in a SUBPROCESS with a hard timeout
+# (init can hang indefinitely when the tunnel is half-up), and on
+# failure the bench falls back to CPU with the probe's error recorded in
+# the JSON payload instead of a raw traceback.
+#
+# The reference publishes no numbers (BASELINE.md), so vs_baseline for
+# the headline is reported against REFERENCE_IMAGES_PER_SEC below — the
 # widely reproduced single-GPU (V100-class) torch throughput ballpark
-# for CIFAR ResNet-18 training, ~3000 img/s at its throughput-optimal
-# batch size (the north-star asks for "matching single-GPU wall-clock",
-# BASELINE.json). We likewise measure at our throughput-friendly batch
-# (BATCH_SIZE below; recorded here since the JSON line carries only the
-# headline number).
-"""flashy_tpu benchmark: CIFAR ResNet-18 images/sec/chip."""
+# for CIFAR ResNet-18 training (~3000 img/s at its throughput-optimal
+# batch size); the self-grounded number is extra.lm.mfu.
+"""flashy_tpu benchmark: CIFAR img/s/chip + Transformer-LM tokens/s + MFU."""
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
 
 REFERENCE_IMAGES_PER_SEC = 3000.0  # single-GPU torch reference ballpark
 
-BATCH_SIZE = 512   # large enough to keep the MXU fed on one chip
-WARMUP_STEPS = 5
-MEASURE_STEPS = 30
+PROBE_TIMEOUT_S = float(os.environ.get("FLASHY_TPU_BENCH_PROBE_TIMEOUT", "420"))
+
+# Peak bf16 matmul FLOP/s per chip, by device_kind substring (public
+# cloud.google.com/tpu/docs numbers).
+PEAK_FLOPS = [
+    ("v6e", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
 
-def main() -> None:
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def probe_backend(timeout: float):
+    """Initialize the accelerator backend in a child process.
+
+    Returns (info_dict, None) on success or (None, error_string) on
+    failure — including the hang case, which a raw `jax.devices()` in
+    this process could never recover from.
+    """
+    code = (
+        "import json, sys\n"
+        "import jax\n"
+        "from flashy_tpu.utils import pin_platform\n"
+        "pin_platform()\n"
+        "ds = jax.devices()\n"
+        "print(json.dumps({'platform': jax.default_backend(),"
+        " 'n_devices': len(ds), 'device_kind': ds[0].device_kind}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, f"backend init timed out after {timeout:.0f}s (tunnel down/hung?)"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return None, f"backend init failed rc={proc.returncode}: {' | '.join(tail)}"
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1]), None
+    except Exception as exc:  # noqa: BLE001
+        return None, f"probe output unparsable: {exc}"
+
+
+def bench_cifar(jax, on_tpu: bool):
+    import jax.numpy as jnp
+    import numpy as np
     import optax
     from flashy_tpu.models import resnet18
-    from flashy_tpu.parallel import make_mesh, shard_batch, wrap
+    from flashy_tpu.parallel import make_mesh, wrap
+    from flashy_tpu.data import prefetch_to_device
+
+    batch_size = 512 if on_tpu else 64
+    warmup, measure = (5, 30) if on_tpu else (2, 5)
 
     devices = jax.devices()
-    n_chips = len(devices)
-    mesh = make_mesh({"data": n_chips})
-
+    mesh = make_mesh({"data": len(devices)})
     model = resnet18(num_classes=10)
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
                            train=False)
@@ -72,35 +121,199 @@ def main() -> None:
 
     rng = np.random.default_rng(0)
     host_batches = [{
-        "image": rng.normal(size=(BATCH_SIZE, 32, 32, 3)).astype(np.float32),
-        "label": rng.integers(0, 10, BATCH_SIZE).astype(np.int32),
+        "image": rng.normal(size=(batch_size, 32, 32, 3)).astype(np.float32),
+        "label": rng.integers(0, 10, batch_size).astype(np.int32),
     } for _ in range(4)]
-
-    from flashy_tpu.data import prefetch_to_device
 
     def batch_stream(n_steps):
         return prefetch_to_device(
             (host_batches[i % len(host_batches)] for i in range(n_steps)),
             size=2, mesh=mesh, batch_axes=("data",))
 
-    for batch in batch_stream(WARMUP_STEPS):
+    for batch in batch_stream(warmup):
         state, metrics = train_step(state, batch)
     jax.block_until_ready(state["params"])
 
     begin = time.perf_counter()
-    for batch in batch_stream(MEASURE_STEPS):
+    for batch in batch_stream(measure):
         state, metrics = train_step(state, batch)
     jax.block_until_ready(state["params"])
     elapsed = time.perf_counter() - begin
 
-    images_per_sec = MEASURE_STEPS * BATCH_SIZE / elapsed
-    per_chip = images_per_sec / n_chips
-    print(json.dumps({
+    per_chip = measure * batch_size / elapsed / len(devices)
+    log(f"cifar: {per_chip:.1f} img/s/chip (batch {batch_size}, {measure} steps)")
+    return {"images_per_sec_per_chip": round(per_chip, 1),
+            "batch_size": batch_size}
+
+
+def bench_lm(jax, on_tpu: bool, peak_flops):
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+
+    if on_tpu:
+        dim, layers, heads, vocab, seq, batch = 1024, 12, 16, 32768, 1024, 16
+        warmup, measure = 3, 10
+    else:
+        dim, layers, heads, vocab, seq, batch = 128, 2, 4, 512, 128, 4
+        warmup, measure = 1, 3
+
+    cfg = TransformerConfig(vocab_size=vocab, dim=dim, num_layers=layers,
+                            num_heads=heads, attention="dense")
+    model = TransformerLM(cfg)
+    params = {"params": model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 128), jnp.int32))["params"]}
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(params))
+
+    optim = optax.adamw(1e-4)
+    opt_state = optim.init(params)
+    state = {"params": params, "opt_state": opt_state}
+
+    def train_step(state, tokens):
+        def loss_fn(variables):
+            logits = model.apply(variables, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt_state = optim.update(grads, state["opt_state"],
+                                          state["params"])
+        return ({"params": optax.apply_updates(state["params"], updates),
+                 "opt_state": opt_state}, loss)
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+
+    for _ in range(warmup):
+        state, loss = step(state, tokens)
+    jax.block_until_ready(loss)
+
+    begin = time.perf_counter()
+    for _ in range(measure):
+        state, loss = step(state, tokens)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - begin
+
+    n_chips = len(jax.devices())
+    tokens_per_sec = measure * batch * seq / elapsed
+    tokens_per_sec_per_chip = tokens_per_sec / n_chips
+    # Analytic train FLOPs/token: 6*P for the matmuls (fwd+bwd), plus
+    # causal attention 6*L*T*dim (12*L*T*dim for full attention, halved
+    # for the causal mask).
+    flops_per_token = 6.0 * n_params + 6.0 * layers * seq * dim
+    achieved = flops_per_token * tokens_per_sec / n_chips
+    mfu = round(achieved / peak_flops, 4) if peak_flops else None
+    log(f"lm: {tokens_per_sec_per_chip:.0f} tok/s/chip, "
+        f"{achieved / 1e12:.1f} TFLOP/s/chip, MFU={mfu} "
+        f"({n_params / 1e6:.0f}M params, seq {seq}, batch {batch})")
+    return {"tokens_per_sec_per_chip": round(tokens_per_sec_per_chip, 1),
+            "mfu": mfu,
+            "achieved_tflops_per_chip": round(achieved / 1e12, 2),
+            "n_params": n_params, "seq_len": seq, "batch_size": batch}
+
+
+def bench_flash_attention(jax, on_tpu: bool):
+    """Pallas flash attention vs XLA dense attention, fwd+bwd step time."""
+    import jax.numpy as jnp
+    import numpy as np
+    from flashy_tpu.ops import attention as attn_mod
+
+    if on_tpu:
+        b, h, t, d = 4, 16, 2048, 64
+        reps = 10
+    else:
+        b, h, t, d = 1, 2, 256, 32
+        reps = 2
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.bfloat16)
+               for _ in range(3))
+
+    def timed(fn):
+        grad = jax.jit(jax.grad(lambda q, k, v: fn(q, k, v, causal=True)
+                                .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+        out = grad(q, k, v)
+        jax.block_until_ready(out)
+        begin = time.perf_counter()
+        for _ in range(reps):
+            out = grad(q, k, v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - begin) / reps
+
+    try:
+        dense_t = timed(attn_mod.dot_product_attention)
+        # Only label a 'flash' timing when the pallas kernel actually
+        # runs: on GPU backends flash_attention falls back to the dense
+        # path and the comparison would be meaningless.
+        flash_t = (timed(attn_mod.flash_attention)
+                   if jax.default_backend() == "tpu" else None)
+    except Exception as exc:  # noqa: BLE001
+        log(f"flash-attention bench skipped: {exc}")
+        return {"error": str(exc)[:200]}
+    result = {"dense_ms": round(dense_t * 1e3, 2),
+              "shape": [b, t, h, d]}
+    if flash_t is not None:
+        result["flash_ms"] = round(flash_t * 1e3, 2)
+        result["speedup"] = round(dense_t / flash_t, 2)
+    log(f"attention fwd+bwd: dense {result['dense_ms']}ms"
+        + (f", flash {result['flash_ms']}ms" if flash_t else ""))
+    return result
+
+
+def main() -> None:
+    info, probe_error = probe_backend(PROBE_TIMEOUT_S)
+    import jax
+    from flashy_tpu.utils import pin_platform
+    if info is None:
+        log(f"TPU probe failed: {probe_error}; falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
+        platform, device_kind = "cpu", "cpu-fallback"
+    else:
+        pin_platform()
+        platform, device_kind = info["platform"], info["device_kind"]
+        log(f"backend up: {info}")
+    on_tpu = platform not in ("cpu",)
+
+    peak = None
+    kind_lower = device_kind.lower()
+    for needle, flops in PEAK_FLOPS:
+        if needle in kind_lower:
+            peak = flops
+            break
+
+    extra = {"platform": platform, "device_kind": device_kind,
+             "n_devices": len(jax.devices()),
+             "peak_bf16_tflops": peak / 1e12 if peak else None}
+    if probe_error:
+        extra["backend_error"] = probe_error
+
+    failures = []
+    for name, fn in (("cifar", lambda: bench_cifar(jax, on_tpu)),
+                     ("lm", lambda: bench_lm(jax, on_tpu, peak)),
+                     ("attention", lambda: bench_flash_attention(jax, on_tpu))):
+        try:
+            extra[name] = fn()
+        except Exception as exc:  # noqa: BLE001
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            extra[name] = {"error": str(exc)[:300]}
+            failures.append(name)
+
+    headline = extra.get("cifar", {}).get("images_per_sec_per_chip")
+    payload = {
         "metric": "cifar10_resnet18_train_images_per_sec_per_chip",
-        "value": round(per_chip, 1),
+        "value": headline,
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC, 3),
-    }))
+        "vs_baseline": (round(headline / REFERENCE_IMAGES_PER_SEC, 3)
+                        if headline else None),
+        "extra": extra,
+    }
+    print(json.dumps(payload), flush=True)
+    # rc=0 whenever the headline number exists (even on CPU fallback);
+    # rc=1 only when the bench itself could not produce it.
+    sys.exit(0 if headline is not None else 1)
 
 
 if __name__ == "__main__":
