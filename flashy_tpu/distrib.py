@@ -20,6 +20,7 @@
 # `world_size() == 1`, so the same solver code runs single-process —
 # the property the reference's helpers all share.
 """Communication and DDP-alternative helpers for TPU training."""
+import functools
 from functools import wraps
 import logging
 import os
@@ -216,7 +217,69 @@ def average_metrics(metrics: tp.Dict[str, float], count: float = 1.0) -> tp.Dict
     return dict(zip(keys, (total[:-1] / total[-1]).tolist()))
 
 
-def average_tensors(tree: tp.Any) -> tp.Any:
+# Above this many bytes, average_tensors switches from a process
+# allgather (every host receives world_size full copies) to an in-graph
+# reduction (O(N) on the wire): syncing a large model across an 8-host
+# pod should not move 8x the model per step.
+REDUCE_MIN_BYTES = 1 << 20
+
+
+def _one_device_per_process_mesh():
+    from jax.sharding import Mesh
+    first: tp.Dict[int, tp.Any] = {}
+    for device in jax.devices():
+        first.setdefault(device.process_index, device)
+    devices = [first[i] for i in sorted(first)]
+    return Mesh(np.array(devices), ("proc",))
+
+
+@functools.lru_cache(maxsize=None)
+def _mean_over_processes_fn(mesh):
+    """Jitted mean over the process dim, cached per mesh — a fresh
+    jit(lambda) per call would recompile a model-sized reduction on
+    every sync step (jit caches on function identity)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.jit(lambda a: a.mean(axis=0),
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+def _reduce_mean_across_processes(floats: tp.List[np.ndarray]) -> tp.List[np.ndarray]:
+    """Average per-process host arrays with an XLA reduction.
+
+    Leaves are grouped by dtype and concatenated into one vector per
+    dtype; each process contributes its vector as one shard of a
+    [world, N] global array over a one-device-per-process mesh, and a
+    jitted mean over the process dim lowers to a reduce — bytes on the
+    wire O(N) per process versus the allgather's O(world * N).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _one_device_per_process_mesh()
+    local_device = {d.process_index: d for d in mesh.devices.flat}[
+        jax.process_index()]
+    world = world_size()
+
+    by_dtype: tp.Dict[np.dtype, tp.List[int]] = {}
+    for index, leaf in enumerate(floats):
+        by_dtype.setdefault(leaf.dtype, []).append(index)
+
+    out: tp.List[tp.Optional[np.ndarray]] = [None] * len(floats)
+    for dtype, indices in by_dtype.items():
+        flat = np.concatenate([floats[i].reshape(-1) for i in indices])
+        sharding = NamedSharding(mesh, P("proc", None))
+        local = jax.device_put(flat[None], local_device)
+        global_arr = jax.make_array_from_single_device_arrays(
+            (world, flat.size), sharding, [local])
+        mean = _mean_over_processes_fn(mesh)(global_arr)
+        reduced = np.asarray(mean.addressable_data(0))
+        offset = 0
+        for i in indices:
+            size = floats[i].size
+            out[i] = reduced[offset:offset + size].reshape(floats[i].shape)
+            offset += size
+    return tp.cast(tp.List[np.ndarray], out)
+
+
+def average_tensors(tree: tp.Any, *, method: str = "auto") -> tp.Any:
     """Mean of every float leaf across processes; returns the new pytree.
 
     Non-float leaves (step counters, int buffers) pass through untouched,
@@ -224,14 +287,27 @@ def average_tensors(tree: tp.Any) -> tp.Any:
     flashy/distrib.py:92-111. This is the *host-side parity path*; inside
     a jitted step prefer mesh sharding (`flashy_tpu.parallel`), where XLA
     fuses and overlaps the reduction.
+
+    `method`: 'allgather' (every process receives all copies — lowest
+    latency for small metric trees), 'reduce' (in-graph reduction, O(N)
+    bytes on the wire — the right choice for model-sized trees), or
+    'auto' (reduce above REDUCE_MIN_BYTES).
     """
     if not is_distributed():
         return tree
-    from jax.experimental import multihost_utils
     floats, treedef = _partition_floats(tree)
     _check_tree_sizes(floats)
-    gathered = multihost_utils.process_allgather(floats)
-    averaged = jax.tree_util.tree_map(lambda x: x.mean(axis=0), gathered)
+    if method == "auto":
+        total = sum(leaf.nbytes for leaf in floats)
+        method = "reduce" if total >= REDUCE_MIN_BYTES else "allgather"
+    if method == "reduce":
+        averaged: tp.Any = _reduce_mean_across_processes(floats)
+    elif method == "allgather":
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(floats)
+        averaged = jax.tree_util.tree_map(lambda x: x.mean(axis=0), gathered)
+    else:
+        raise ValueError(f"unknown method {method!r}")
     return _combine_floats(tree, treedef, averaged)
 
 

@@ -51,6 +51,18 @@ WORKER_SCRIPT = textwrap.dedent("""
     except RuntimeError:
         pass
 
+    # reduction-based sync path (the large-tree route of average_tensors):
+    # explicit method= and the auto threshold must both hit it and agree
+    # with the true mean. Mixed dtypes exercise the per-dtype grouping.
+    big = {"a": np.full((400_000,), float(rank + 1), np.float32),   # >1MiB
+           "b": np.full((7,), float(rank), np.float64)}
+    out = distrib.average_tensors(big)  # auto -> reduce
+    check("reduce_auto_f32", np.allclose(out["a"], (ws + 1) / 2.0))
+    check("reduce_auto_f64", np.allclose(out["b"], (ws - 1) / 2.0))
+    small = {"w": np.full((3,), float(rank + 1), np.float32)}
+    out = distrib.average_tensors(small, method="reduce")
+    check("reduce_explicit", np.allclose(out["w"], (ws + 1) / 2.0))
+
     # average_metrics with per-rank weights: weighted mean
     metrics = distrib.average_metrics({"loss": float(rank)}, count=rank + 1)
     weights = sum(r + 1 for r in range(ws))
