@@ -1,0 +1,114 @@
+# Launcher + sweep plumbing: env/argv construction is pure and tested
+# without ssh or a pod; a real end-to-end ssh-less run uses `ssh_bin`
+# injection with /bin/sh.
+import subprocess
+import sys
+
+import pytest
+
+from flashy_tpu.launch import (HostCommand, gcloud_tpu_pod_argv,
+                               main as launch_main, plan_ssh, ssh_argv)
+from flashy_tpu.sweep import expand_grid, main as sweep_main
+
+
+def test_plan_ssh_env_plumbing():
+    plan = plan_ssh(["python", "-m", "pkg.train", "lr=0.1"],
+                    ["h0", "h1", "h2"], port=1234)
+    assert [c.host for c in plan] == ["h0", "h1", "h2"]
+    for index, cmd in enumerate(plan):
+        assert cmd.env["FLASHY_TPU_COORDINATOR"] == "h0:1234"
+        assert cmd.env["FLASHY_TPU_NUM_PROCESSES"] == "3"
+        assert cmd.env["FLASHY_TPU_PROCESS_ID"] == str(index)
+        assert cmd.argv == ["python", "-m", "pkg.train", "lr=0.1"]
+
+
+def test_shell_line_quotes():
+    cmd = HostCommand("h", {"A": "x y"}, ["echo", "a b"])
+    line = cmd.shell_line()
+    assert "A='x y'" in line and "'a b'" in line
+
+
+def test_ssh_argv():
+    plan = plan_ssh(["true"], ["hostA"])
+    assert ssh_argv(plan[0])[:2] == ["ssh", "hostA"]
+
+
+def test_gcloud_tpu_pod_argv():
+    argv = gcloud_tpu_pod_argv(["python", "train.py", "lr=0.1"],
+                               name="my-pod", zone="us-central2-b",
+                               project="proj")
+    assert argv[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh", "my-pod"]
+    assert "--worker=all" in argv
+    assert argv[argv.index("--project") + 1] == "proj"
+    assert argv[-1] == "python train.py lr=0.1"
+
+
+def test_plan_shell_lines_execute_with_env(tmp_path):
+    # Execute each host's exact remote line locally through /bin/sh:
+    # proves the env+argv plumbing end to end without ssh.
+    out = tmp_path / "out.txt"
+    plan = plan_ssh(["sh", "-c", f"echo $FLASHY_TPU_PROCESS_ID >> {out}"],
+                    ["h0", "h1"])
+    for cmd in plan:
+        result = subprocess.run(["/bin/sh", "-c", cmd.shell_line()],
+                                capture_output=True, text=True)
+        assert result.returncode == 0, result.stderr
+    assert sorted(out.read_text().split()) == ["0", "1"]
+
+
+def test_launch_cli_dry_run(capsys):
+    code = launch_main(["ssh", "--hosts", "a,b", "--dry-run", "--",
+                        "python", "train.py"])
+    assert code == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    assert "FLASHY_TPU_PROCESS_ID=0" in lines[0]
+    assert "FLASHY_TPU_PROCESS_ID=1" in lines[1]
+    assert all(line.startswith("ssh ") for line in lines)
+
+
+def test_launch_cli_tpu_pod_dry_run(capsys):
+    code = launch_main(["tpu-pod", "--name", "p", "--zone", "z",
+                        "--dry-run", "--", "python", "train.py"])
+    assert code == 0
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh p" in out
+    assert "--worker=all" in out
+
+
+def test_launch_cli_requires_command():
+    with pytest.raises(SystemExit):
+        launch_main(["ssh", "--hosts", "a"])
+
+
+def test_expand_grid():
+    points = expand_grid(["lr=0.1,0.3", "dim=256,512", "tag=x"])
+    assert len(points) == 4
+    assert ["lr=0.1", "dim=256", "tag=x"] in points
+    assert ["lr=0.3", "dim=512", "tag=x"] in points
+
+
+def test_expand_grid_bracket_values_not_split():
+    (point,) = expand_grid(["layers=[1,2,3]"])
+    assert point == ["layers=[1,2,3]"]
+
+
+def test_expand_grid_rejects_bare_token():
+    with pytest.raises(ValueError):
+        expand_grid(["nonsense"])
+
+
+def test_sweep_cli_runs_each_point(tmp_path):
+    marker = tmp_path / "calls.txt"
+    code = sweep_main(
+        ["a=1,2", "--", sys.executable, "-c",
+         f"import sys; open(r'{marker}', 'a').write(sys.argv[-1] + chr(10))"])
+    assert code == 0
+    assert sorted(marker.read_text().split()) == ["a=1", "a=2"]
+
+
+def test_sweep_cli_dry_run(capsys):
+    code = sweep_main(["--dry-run", "a=1,2", "--", "python", "t.py"])
+    assert code == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out == ["python t.py a=1", "python t.py a=2"]
