@@ -260,6 +260,9 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
                                offset=t_k - t_q)
+    # Inside shard_map the outputs vary over the same mesh axes as the
+    # inputs; pallas_call requires that stated explicitly on out_shape.
+    vma = jax.typeof(q).vma
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -273,8 +276,9 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
             pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((batch * heads, t_q, dim), q.dtype),
-            jax.ShapeDtypeStruct((batch * heads, t_q, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((batch * heads, t_q, dim), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((batch * heads, t_q, LANES), jnp.float32,
+                                 vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),  # running max
@@ -287,7 +291,8 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
 
 
 def _flash_backward(q, k, v, out, lse, grad_out, *, causal: bool,
-                    block_q: int, block_k: int, interpret: bool):
+                    block_q: int, block_k: int, interpret: bool,
+                    delta=None):
     batch, t_q, heads, dim = q.shape
     t_k = k.shape[1]
     scale = 1.0 / np.sqrt(dim)
@@ -296,11 +301,15 @@ def _flash_backward(q, k, v, out, lse, grad_out, *, causal: bool,
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     dof = _fold(grad_out)
 
-    # D = rowsum(dO * O): cheap elementwise+reduce, leave it to XLA; the
-    # kernels read it broadcast over the lane dim like the lse.
-    delta = jnp.sum(_fold(grad_out).astype(jnp.float32)
-                    * _fold(out).astype(jnp.float32), axis=-1)   # [BH, T_q]
-    delta = jnp.broadcast_to(delta[:, :, None], (bh, t_q, LANES))
+    if delta is None:
+        # D = rowsum(dO * O): cheap elementwise+reduce, leave it to XLA;
+        # the kernels read it broadcast over the lane dim like the lse.
+        # Callers invoking this once per block (ring attention) pass the
+        # precomputed [BH, T_q, LANES] value instead — D depends only on
+        # the global out/dO, so it is identical for every block.
+        delta = jnp.sum(dof.astype(jnp.float32)
+                        * _fold(out).astype(jnp.float32), axis=-1)  # [BH, T_q]
+        delta = jnp.broadcast_to(delta[:, :, None], (bh, t_q, LANES))
 
     row_specs = [
         pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),    # q
@@ -310,13 +319,14 @@ def _flash_backward(q, k, v, out, lse, grad_out, *, causal: bool,
         pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),  # lse
         pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),  # D
     ]
+    vma = jax.typeof(q).vma
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, offset=offset),
         grid=(bh, t_q // block_q, t_k // block_k),
         in_specs=row_specs,
         out_specs=pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q, dim), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, dim), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, dim), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
@@ -339,8 +349,8 @@ def _flash_backward(q, k, v, out, lse, grad_out, *, causal: bool,
             pl.BlockSpec((1, block_k, dim), lambda b, ki, qi: (b, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t_k, dim), k.dtype),
-            jax.ShapeDtypeStruct((bh, t_k, dim), v.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, dim), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, t_k, dim), v.dtype, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, dim), jnp.float32),

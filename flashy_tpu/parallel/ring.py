@@ -3,8 +3,19 @@
 # (SURVEY §5: absent there), built TPU-first: each device holds one
 # sequence block of Q/K/V; K/V blocks rotate around the ring via
 # `lax.ppermute` over ICI while each device accumulates its Q block's
-# attention with the online-softmax (flash attention) recurrence, so the
-# full T×T score matrix never materializes and memory stays O(T_local).
+# attention; the full TxT score matrix never materializes and memory
+# stays O(T_local).
+#
+# The per-block compute is the pallas flash kernel (ops/attention) when
+# the shapes allow: each visiting block produces a normalized output
+# plus its logsumexp, and blocks merge with the standard
+# logaddexp-weighted combination — so the MXU-tiled online softmax runs
+# inside every ring step while the next K/V block is in flight on ICI.
+# Gradients are a custom VJP that rotates K/V again, reusing the pallas
+# backward kernels per block with the forward's GLOBAL logsumexp; dK/dV
+# accumulators travel around the ring with their blocks and arrive home
+# after the final hop. Both directions fall back to a pure-XLA block
+# computation off TPU-friendly shapes.
 #
 # Communication pattern follows the ring-attention construction of Liu &
 # Abbeel (blockwise parallel transformers); one K/V block is always in
@@ -17,14 +28,217 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops import attention as _attn
+
 NEG_INF = -1e30
 
 
-def _block_scores(q: jax.Array, k: jax.Array, scale: float) -> jax.Array:
-    # q: [B, Tq, H, D], k: [B, Tk, H, D] -> [B, H, Tq, Tk]
-    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+def _use_pallas(t_q: int, t_k: int, block: int = 128) -> bool:
+    """Pallas path needs pallas importable and 128-aligned block dims."""
+    return (_attn._PALLAS_AVAILABLE and t_q % block == 0 and t_k % block == 0
+            and jax.default_backend() not in ("gpu", "cuda", "rocm"))
 
 
+def _block_sizes(t_q: int, t_k: int) -> tp.Tuple[int, int]:
+    """Largest kernel tile that DIVIDES each length (the kernels' grid
+    floor-divides, so a non-dividing tile would silently drop rows —
+    t_local=384 with a 256 tile covers only rows 0-255)."""
+
+    def pick(t: int) -> int:
+        for size in (512, 256, 128):
+            if t % size == 0:
+                return size
+        return t  # t < 128: only reachable in interpret mode
+
+    return pick(t_q), pick(t_k)
+
+
+def _block_forward(q, k, v, *, causal_diag: bool):
+    """One ring block: returns (out [B,T,H,D] f32 normalized, lse [B,H,T]).
+
+    `causal_diag=True` applies the self-block causal mask (offset 0);
+    False means the block is fully visible.
+    """
+    batch, t_q, heads, head_dim = q.shape
+    t_k = k.shape[1]
+    if _use_pallas(t_q, t_k):
+        block_q, block_k = _block_sizes(t_q, t_k)
+        out, lse = _attn._flash_forward(
+            q, k, v, causal=causal_diag, block_q=block_q, block_k=block_k,
+            interpret=jax.default_backend() == "cpu")
+        lse_rows = lse[:, :, 0].reshape(batch, heads, t_q)
+        return out.astype(jnp.float32), lse_rows
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal_diag:
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    m = scores.max(axis=-1)                          # [B, H, Tq]
+    probs = jnp.exp(scores - m[..., None])
+    denom = jnp.maximum(probs.sum(axis=-1), 1e-30)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs / denom[..., None],
+                     v.astype(jnp.float32))
+    return out, m + jnp.log(denom)
+
+
+def _block_backward(q, k, v, out_global, do, lse_rows, delta_rows, *,
+                    causal_diag: bool):
+    """Per-block gradients from the GLOBAL logsumexp: (dq, dk, dv).
+
+    probs = exp(scores - lse_global) are the exact global attention
+    weights for this block, so each block's contribution is independent
+    and sums to the full gradient — the decomposition the pallas
+    backward kernels implement.
+    """
+    batch, t_q, heads, head_dim = q.shape
+    t_k = k.shape[1]
+    if _use_pallas(t_q, t_k):
+        block_q, block_k = _block_sizes(t_q, t_k)
+        # kernels read lse/delta broadcast over the 128-lane dim, [BH, T]
+        lse = jnp.broadcast_to(
+            lse_rows.reshape(batch * heads, t_q)[:, :, None],
+            (batch * heads, t_q, _attn.LANES))
+        delta = jnp.broadcast_to(
+            delta_rows.reshape(batch * heads, t_q)[:, :, None],
+            (batch * heads, t_q, _attn.LANES))
+        return _attn._flash_backward(
+            q, k, v, out_global, lse, do, causal=causal_diag,
+            block_q=block_q, block_k=block_k,
+            interpret=jax.default_backend() == "cpu", delta=delta)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal_diag:
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jnp.exp(scores - lse_rows[..., None])     # [B, H, Tq, Tk]
+    do_f = do.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", probs, do_f)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do_f, v.astype(jnp.float32))
+    ds = probs * (dp - delta_rows[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _merge(out_acc, lse_acc, out_blk, lse_blk):
+    """logaddexp merge of two normalized partial attentions."""
+    new_lse = jnp.logaddexp(lse_acc, lse_blk)        # [B, H, T]
+    w_acc = jnp.exp(lse_acc - new_lse).transpose(0, 2, 1)[..., None]
+    w_blk = jnp.exp(lse_blk - new_lse).transpose(0, 2, 1)[..., None]
+    return out_acc * w_acc + out_blk * w_blk, new_lse
+
+
+def _mark_varying(tree, like):
+    """Make every leaf device-varying on the axes `like` varies over —
+    scan carries need stable varying types, and block outputs computed
+    purely from replicated inputs would otherwise come back invariant."""
+    target = set(jax.typeof(like).vma)
+    if not target:
+        return tree
+
+    def mark(x):
+        missing = tuple(target - set(jax.typeof(x).vma))
+        return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+    return jax.tree_util.tree_map(mark, tree)
+
+
+def _ring_forward_pass(q, k, v, axis_name: str, causal: bool):
+    """Returns (out [B,T,H,D] in q.dtype, lse [B,H,T])."""
+    n_blocks = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+
+    # Step 0: the device's own (diagonal) block.
+    out, lse = _block_forward(q, k, v, causal_diag=causal)
+    out, lse = _mark_varying((out, lse), q)
+
+    if n_blocks > 1:
+        def step(carry, step_index):
+            out_acc, lse_acc, k_blk, v_blk = carry
+            # Rotate first: at step s the visiting block's owner is
+            # (my_index - s) mod n — same schedule as the backward.
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            if causal:
+                # owner < my_index  <=>  my_index >= s: fully visible;
+                # otherwise the block is entirely in the future — skip
+                # compute AND merge (cond, so the skipped branch costs
+                # nothing on-device).
+                def visible(args):
+                    out_acc, lse_acc, k_blk, v_blk = args
+                    out_b, lse_b = _block_forward(q, k_blk, v_blk,
+                                                  causal_diag=False)
+                    out_acc, lse_acc = _merge(out_acc, lse_acc, out_b, lse_b)
+                    return out_acc, lse_acc
+
+                out_acc, lse_acc = jax.lax.cond(
+                    my_index >= step_index, visible,
+                    lambda args: (args[0], args[1]),
+                    (out_acc, lse_acc, k_blk, v_blk))
+            else:
+                out_b, lse_b = _block_forward(q, k_blk, v_blk,
+                                              causal_diag=False)
+                out_acc, lse_acc = _merge(out_acc, lse_acc, out_b, lse_b)
+            return (out_acc, lse_acc, k_blk, v_blk), None
+
+        (out, lse, _, _), _ = jax.lax.scan(
+            step, (out, lse, k, v), jnp.arange(1, n_blocks))
+    return out.astype(q.dtype), lse
+
+
+def _ring_backward_pass(q, k, v, out, lse, do, axis_name: str, causal: bool):
+    n_blocks = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+
+    # D = rowsum(dO * O) over the GLOBAL output: identical for every
+    # block this device processes.
+    delta_rows = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                         axis=-1).transpose(0, 2, 1)   # [B, H, Tq]
+
+    dq, dk, dv = _block_backward(q, k, v, out, do, lse, delta_rows,
+                                 causal_diag=causal)
+    # Accumulate across ring steps in f32 (matching the forward merge);
+    # summing per-block bf16 grads would compound rounding once per hop.
+    dq, dk, dv = (g.astype(jnp.float32) for g in (dq, dk, dv))
+    dq, dk, dv = _mark_varying((dq, dk, dv), q)
+
+    if n_blocks > 1:
+        def step(carry, step_index):
+            dq_acc, k_blk, v_blk, dk_acc, dv_acc = carry
+            # dK/dV accumulators travel WITH their block.
+            k_blk, v_blk, dk_acc, dv_acc = jax.lax.ppermute(
+                (k_blk, v_blk, dk_acc, dv_acc), axis_name, perm)
+
+            def visible(args):
+                dq_acc, dk_acc, dv_acc = args
+                dq_b, dk_b, dv_b = _block_backward(
+                    q, k_blk, v_blk, out, do, lse, delta_rows,
+                    causal_diag=False)
+                return (dq_acc + dq_b.astype(jnp.float32),
+                        dk_acc + dk_b.astype(jnp.float32),
+                        dv_acc + dv_b.astype(jnp.float32))
+
+            if causal:
+                dq_acc, dk_acc, dv_acc = jax.lax.cond(
+                    my_index >= step_index, visible, lambda args: args,
+                    (dq_acc, dk_acc, dv_acc))
+            else:
+                dq_acc, dk_acc, dv_acc = visible((dq_acc, dk_acc, dv_acc))
+            return (dq_acc, k_blk, v_blk, dk_acc, dv_acc), None
+
+        (dq, _, _, dk, dv), _ = jax.lax.scan(
+            step, (dq, k, v, dk, dv), jnp.arange(1, n_blocks))
+        # n-1 hops so far; one more returns each accumulator to the
+        # device that owns its K/V block.
+        dk, dv = jax.lax.ppermute((dk, dv), axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "seq", causal: bool = False) -> jax.Array:
     """Attention over a sequence sharded on `axis_name`.
@@ -39,53 +253,21 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     sequence. Positions are global: block b covers
     [b * t_local, (b+1) * t_local).
     """
-    n_blocks = jax.lax.psum(1, axis_name)
-    my_index = jax.lax.axis_index(axis_name)
-    batch, t_local, heads, head_dim = q.shape
-    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, dtype=jnp.float32))
+    out, _ = _ring_forward_pass(q, k, v, axis_name, causal)
+    return out
 
-    q_pos = my_index * t_local + jnp.arange(t_local)
 
-    def step(carry, step_index):
-        out_acc, row_max, row_sum, k_blk, v_blk = carry
-        k_owner = (my_index - step_index) % n_blocks
-        scores = _block_scores(q, k_blk, scale)  # [B, H, Tq, Tk] f32
-        if causal:
-            k_pos = k_owner * t_local + jnp.arange(t_local)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(mask[None, None], scores, NEG_INF)
-        blk_max = scores.max(axis=-1)  # [B, H, Tq]
-        new_max = jnp.maximum(row_max, blk_max)
-        # Online softmax rescale of the running accumulator.
-        correction = jnp.exp(row_max - new_max)
-        probs = jnp.exp(scores - new_max[..., None])
-        new_sum = row_sum * correction + probs.sum(axis=-1)
-        blk_out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_blk.astype(jnp.float32))
-        new_out = out_acc * correction.transpose(0, 2, 1)[..., None] + blk_out
-        # Rotate K/V one hop around the ring; XLA overlaps this ICI
-        # transfer with the next block's compute.
-        perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
-        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (new_out, new_max, new_sum, k_next, v_next), None
+def _ring_fwd(q, k, v, axis_name, causal):
+    out, lse = _ring_forward_pass(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
 
-    out0 = jnp.zeros((batch, t_local, heads, head_dim), dtype=jnp.float32)
-    max0 = jnp.full((batch, heads, t_local), NEG_INF, dtype=jnp.float32)
-    sum0 = jnp.zeros((batch, heads, t_local), dtype=jnp.float32)
-    # The accumulators start device-invariant but become device-varying
-    # once q enters the recurrence; scan requires matching "varying"
-    # types between carry in and out, so mark them varying up front.
-    varying_axes = jax.typeof(q).vma
-    if varying_axes:
-        axes = tuple(varying_axes)
-        out0, max0, sum0 = (jax.lax.pcast(x, axes, to="varying")
-                            for x in (out0, max0, sum0))
-    (out, _, denom, _, _), _ = jax.lax.scan(
-        step, (out0, max0, sum0, k.astype(jnp.float32), v.astype(jnp.float32)),
-        jnp.arange(n_blocks))
-    denom = jnp.maximum(denom, 1e-30)  # fully-masked rows divide safely
-    out = out / denom.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+
+def _ring_bwd(axis_name, causal, residuals, do):
+    q, k, v, out, lse = residuals
+    return _ring_backward_pass(q, k, v, out, lse, do, axis_name, causal)
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -123,5 +305,9 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             tuple(use_batch_axes))
     spec = P(tuple(use_batch_axes) if use_batch_axes else None, axis, None, None)
     fn = functools.partial(ring_attention, axis_name=axis, causal=causal)
+    # check_vma=False: pallas interpret mode (the CPU test path) cannot
+    # yet propagate varying-axis types through its block slicing — the
+    # workaround the upstream error message prescribes. The vma checker
+    # is a tracer-level lint; numerics are unaffected.
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+                         out_specs=spec, check_vma=False)(q, k, v)
