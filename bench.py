@@ -247,8 +247,14 @@ def bench_flash_attention(jax, on_tpu: bool):
         # Only label a 'flash' timing when the pallas kernel actually
         # runs: on GPU backends flash_attention falls back to the dense
         # path and the comparison would be meaningless.
-        flash_t = (timed(attn_mod.flash_attention)
-                   if jax.default_backend() == "tpu" else None)
+        flash_t = tuned_t = blocks = None
+        if jax.default_backend() == "tpu":
+            flash_t = timed(attn_mod.flash_attention)  # default 256/256
+            from flashy_tpu.ops import tune_flash_blocks
+            blocks = tune_flash_blocks(b, t, h, d, causal=True)
+            bq, bk = blocks
+            tuned_t = timed(lambda q, k, v, causal: attn_mod.flash_attention(
+                q, k, v, causal=causal, block_q=bq, block_k=bk))
     except Exception as exc:  # noqa: BLE001
         log(f"flash-attention bench skipped: {exc}")
         return {"error": str(exc)[:200]}
@@ -257,9 +263,113 @@ def bench_flash_attention(jax, on_tpu: bool):
     if flash_t is not None:
         result["flash_ms"] = round(flash_t * 1e3, 2)
         result["speedup"] = round(dense_t / flash_t, 2)
+    if tuned_t is not None:
+        result["flash_tuned_ms"] = round(tuned_t * 1e3, 2)
+        result["tuned_blocks"] = list(blocks)
     log(f"attention fwd+bwd: dense {result['dense_ms']}ms"
         + (f", flash {result['flash_ms']}ms" if flash_t else ""))
     return result
+
+
+def bench_gan(jax, on_tpu: bool):
+    """The adversarial two-optimizer stage (BASELINE configs[3]): one
+    generator step + one discriminator step per iteration, MLP G/D."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flashy_tpu.adversarial import AdversarialLoss
+
+    dim, hidden, batch = (256, 1024, 1024) if on_tpu else (32, 64, 64)
+    warmup, measure = (3, 10) if on_tpu else (1, 3)
+
+    rngs = jax.random.split(jax.random.PRNGKey(0), 4)
+
+    def mlp_init(key, sizes):
+        params = []
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            k = jax.random.fold_in(key, i)
+            params.append({"w": jax.random.normal(k, (a, b)) * (1.0 / np.sqrt(a)),
+                           "b": jnp.zeros(b)})
+        return params
+
+    def mlp_apply(params, x):
+        for i, layer in enumerate(params):
+            x = x @ layer["w"] + layer["b"]
+            if i < len(params) - 1:
+                x = jax.nn.leaky_relu(x, 0.2)
+        return x
+
+    g_params = mlp_init(rngs[0], [dim, hidden, dim])
+    d_params = mlp_init(rngs[1], [dim, hidden, 1])
+    g_optim = optax.adam(1e-4)
+    g_opt_state = g_optim.init(g_params)
+    adv = AdversarialLoss(mlp_apply, d_params, optax.adam(1e-4))
+
+    real = jax.random.normal(rngs[2], (batch, dim))
+    noise = jax.random.normal(rngs[3], (batch, dim))
+
+    def g_step(g_params, g_opt_state, d_params, noise):
+        def loss_fn(gp):
+            fake = mlp_apply(gp, noise)
+            return adv.gen_loss(d_params, fake)
+
+        loss, grads = jax.value_and_grad(loss_fn)(g_params)
+        updates, g_opt_state = g_optim.update(grads, g_opt_state)
+        return optax.apply_updates(g_params, updates), g_opt_state, loss
+
+    g_step = jax.jit(g_step)
+
+    def iteration():
+        fake = mlp_apply(g_params, noise)
+        adv.train_adv(fake, real)
+        return g_step(g_params, g_opt_state, adv.params, noise)
+
+    for _ in range(warmup):
+        g_params, g_opt_state, loss = iteration()
+    jax.block_until_ready(loss)
+    begin = time.perf_counter()
+    for _ in range(measure):
+        g_params, g_opt_state, loss = iteration()
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - begin
+
+    steps_per_sec = measure / elapsed
+    log(f"gan: {steps_per_sec:.1f} G+D steps/sec (dim {dim}, batch {batch})")
+    return {"steps_per_sec": round(steps_per_sec, 2),
+            "batch_size": batch, "dim": dim}
+
+
+def bench_all_reduce(jax):
+    """psum bus bandwidth over the attached devices (multi-chip only)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return {"skipped": "single device; ICI bandwidth needs >= 2 chips"}
+    n = len(devices)
+    size = 64 * 1024 * 1024 // 4  # 64 MiB of f32 per device
+    mesh = Mesh(np.array(devices), ("d",))
+    # Materialize directly sharded: building the full array on one chip
+    # first would spike O(n_devices * 64MiB) HBM on device 0.
+    x = jax.jit(lambda: jnp.ones((n, size), jnp.float32),
+                out_shardings=NamedSharding(mesh, P("d", None)))()
+    reduce = jax.jit(lambda a: a.sum(axis=0),
+                     out_shardings=NamedSharding(mesh, P()))
+    jax.block_until_ready(reduce(x))
+    reps = 10
+    begin = time.perf_counter()
+    for _ in range(reps):
+        out = reduce(x)
+    jax.block_until_ready(out)
+    elapsed = (time.perf_counter() - begin) / reps
+    # ring all-reduce moves 2*(n-1)/n of the data per device
+    bus_bytes = 2 * (n - 1) / n * size * 4
+    gbps = bus_bytes / elapsed / 1e9
+    log(f"all_reduce: {gbps:.1f} GB/s bus bandwidth over {n} devices")
+    return {"bus_bandwidth_gb_s": round(gbps, 2), "n_devices": n,
+            "payload_mib": 64}
 
 
 def main() -> None:
@@ -289,17 +399,17 @@ def main() -> None:
     if probe_error:
         extra["backend_error"] = probe_error
 
-    failures = []
     for name, fn in (("cifar", lambda: bench_cifar(jax, on_tpu)),
                      ("lm", lambda: bench_lm(jax, on_tpu, peak)),
-                     ("attention", lambda: bench_flash_attention(jax, on_tpu))):
+                     ("attention", lambda: bench_flash_attention(jax, on_tpu)),
+                     ("gan", lambda: bench_gan(jax, on_tpu)),
+                     ("all_reduce", lambda: bench_all_reduce(jax))):
         try:
             extra[name] = fn()
         except Exception as exc:  # noqa: BLE001
             import traceback
             traceback.print_exc(file=sys.stderr)
             extra[name] = {"error": str(exc)[:300]}
-            failures.append(name)
 
     headline = extra.get("cifar", {}).get("images_per_sec_per_chip")
     payload = {

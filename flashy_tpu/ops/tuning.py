@@ -1,0 +1,130 @@
+# Kernel block-size autotuning. The pallas flash-attention kernels take
+# (block_q, block_k) tile sizes whose optimum depends on the chip
+# generation, head dim, and sequence length; this module measures the
+# candidates on the live backend once per shape and caches the winner
+# (process-wide, plus an optional on-disk cache so later runs skip the
+# sweep).
+"""Autotune flash-attention block sizes on the attached accelerator."""
+import functools
+import json
+import logging
+import os
+import time
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+# Candidate (block_q, block_k) tiles, all multiples of the 128-lane
+# vector width; the sweep keeps only those dividing the sequence length.
+CANDIDATES: tp.Tuple[tp.Tuple[int, int], ...] = (
+    (128, 128), (128, 256), (256, 128), (256, 256),
+    (256, 512), (512, 256), (512, 512),
+)
+
+_cache: tp.Dict[tp.Tuple, tp.Tuple[int, int]] = {}
+
+
+def _cache_path() -> str:
+    return os.environ.get("FLASHY_TPU_TUNE_CACHE",
+                          os.path.expanduser("~/.cache/flashy_tpu/attn_tune.json"))
+
+
+def _load_disk_cache() -> tp.Dict[str, tp.List[int]]:
+    try:
+        with open(_cache_path()) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _store_disk_cache(key: str, best: tp.Tuple[int, int]) -> None:
+    path = _cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        disk = _load_disk_cache()
+        disk[key] = list(best)
+        with open(path, "w") as f:
+            json.dump(disk, f, indent=0, sort_keys=True)
+    except Exception as exc:  # cache is best-effort
+        logger.debug("could not persist tune cache: %s", exc)
+
+
+def _time_call(fn: tp.Callable[[], tp.Any], reps: int = 5) -> float:
+    out = fn()
+    jax.block_until_ready(out)
+    begin = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - begin) / reps
+
+
+def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int, *,
+                      causal: bool = True, dtype: tp.Any = jnp.bfloat16,
+                      include_backward: bool = True,
+                      candidates: tp.Sequence[tp.Tuple[int, int]] = CANDIDATES,
+                      reps: int = 5,
+                      interpret: tp.Optional[bool] = None) -> tp.Tuple[int, int]:
+    """Measure flash-attention block-size candidates; return the winner.
+
+    Benchmarks the jitted fwd (+bwd) at every viable candidate on the
+    attached backend and caches per (device_kind, shape, causal, dtype)
+    in memory and on disk. On CPU the kernel runs in interpret mode —
+    timing there is meaningless, so the default (256, 256) is returned
+    without sweeping.
+    """
+    from .attention import flash_attention
+
+    device_kind = jax.devices()[0].device_kind
+    key = (device_kind, batch, seq_len, heads, head_dim, causal,
+           str(jnp.dtype(dtype)), include_backward)
+    if key in _cache:
+        return _cache[key]
+    disk_key = "/".join(str(part) for part in key)
+    disk = _load_disk_cache()
+    if disk_key in disk:
+        best = tuple(disk[disk_key])
+        _cache[key] = best  # type: ignore[assignment]
+        return best  # type: ignore[return-value]
+
+    viable = [(bq, bk) for bq, bk in candidates
+              if seq_len % bq == 0 and seq_len % bk == 0]
+    if (jax.default_backend() == "cpu" and not interpret) or not viable:
+        # interpret-mode timings are meaningless; keep the default.
+        return (256, 256)
+
+    shape = (batch, seq_len, heads, head_dim)
+    q = jnp.ones(shape, dtype)
+    k = jnp.ones(shape, dtype)
+    v = jnp.ones(shape, dtype)
+
+    def build(bq: int, bk: int) -> tp.Callable[[], tp.Any]:
+        if include_backward:
+            grad = jax.jit(jax.grad(
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal=causal, block_q=bq, block_k=bk,
+                    interpret=interpret)
+                .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+            return lambda: grad(q, k, v)
+        fwd = jax.jit(functools.partial(flash_attention, causal=causal,
+                                        block_q=bq, block_k=bk,
+                                        interpret=interpret))
+        return lambda: fwd(q, k, v)
+
+    timings: tp.Dict[tp.Tuple[int, int], float] = {}
+    for bq, bk in viable:
+        try:
+            timings[(bq, bk)] = _time_call(build(bq, bk), reps)
+        except Exception as exc:  # tile too large for VMEM, etc.
+            logger.debug("flash tune: (%d, %d) failed: %s", bq, bk, exc)
+    if not timings:
+        return (256, 256)
+    best = min(timings, key=timings.get)  # type: ignore[arg-type]
+    logger.info("flash tune %s: best blocks %s (%.3f ms); swept %d candidates",
+                key, best, timings[best] * 1e3, len(timings))
+    _cache[key] = best
+    _store_disk_cache(disk_key, best)
+    return best

@@ -132,3 +132,44 @@ def test_flash_backward_bf16_dtype_and_close():
     for a, b in zip(grads, ref):
         np.testing.assert_allclose(np.asarray(a, dtype=np.float32),
                                    np.asarray(b), rtol=0.1, atol=0.05)
+
+
+def test_tune_flash_blocks_sweeps_and_caches(tmp_path, monkeypatch):
+    # mechanism test (CPU interpret mode; timings are irrelevant, the
+    # sweep/caching behavior is what matters)
+    import flashy_tpu.ops.tuning as tuning
+    monkeypatch.setenv("FLASHY_TPU_TUNE_CACHE", str(tmp_path / "cache.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    tuning._cache.clear()
+
+    calls = []
+    real = tuning._time_call
+
+    def counting(fn, reps=1):
+        calls.append(1)
+        return real(fn, reps=1)
+
+    monkeypatch.setattr(tuning, "_time_call", counting)
+    best = tuning.tune_flash_blocks(
+        1, 256, 2, 16, candidates=[(128, 128), (256, 256)],
+        include_backward=False, interpret=True)
+    assert best in [(128, 128), (256, 256)]
+    assert len(calls) == 2  # both viable candidates measured
+
+    # second call: memory cache, no sweeping
+    best2 = tuning.tune_flash_blocks(
+        1, 256, 2, 16, candidates=[(128, 128), (256, 256)],
+        include_backward=False, interpret=True)
+    assert best2 == best and len(calls) == 2
+
+    # fresh process simulation: memory cache cleared, disk cache hits
+    tuning._cache.clear()
+    best3 = tuning.tune_flash_blocks(
+        1, 256, 2, 16, candidates=[(128, 128), (256, 256)],
+        include_backward=False, interpret=True)
+    assert best3 == best and len(calls) == 2
+
+
+def test_tune_flash_blocks_cpu_returns_default():
+    from flashy_tpu.ops.tuning import tune_flash_blocks
+    assert tune_flash_blocks(1, 256, 2, 16) == (256, 256)
