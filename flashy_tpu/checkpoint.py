@@ -116,6 +116,42 @@ def sharded_checkpoint_exists(directory: AnyPath) -> bool:
     return slot is not None and (directory / slot / "state.pkl").exists()
 
 
+def _prepare_slot(directory: Path) -> str:
+    """Pick the inactive A/B slot and clear its commit marker (an aborted
+    previous write to it must never look complete). Collective."""
+    from . import distrib
+    active = _read_slot_pointer(directory)
+    target = _SLOTS[1] if active == _SLOTS[0] else _SLOTS[0]
+    slot_dir = directory / target
+    if distrib.is_rank_zero():
+        slot_dir.mkdir(parents=True, exist_ok=True)
+        marker = slot_dir / "state.pkl"
+        if marker.exists():
+            marker.unlink()
+    distrib.barrier("flashy_tpu_ckpt_slot")
+    return target
+
+
+def _commit_slot(directory: Path, target: str, skeleton: tp.Any,
+                 on_commit: tp.Optional[tp.Callable[[], None]] = None) -> None:
+    """Make slot `target` the active checkpoint: write the skeleton (the
+    commit marker), then atomically flip the CURRENT pointer. Collective:
+    no rank returns before the flip is visible (a rank racing ahead
+    could read the OLD checkpoint as current). `on_commit` runs on every
+    rank after the flip — cleanup that must not precede durability."""
+    from . import distrib
+    if distrib.is_rank_zero():
+        with write_and_rename(directory / target / "state.pkl", "wb") as f:
+            pickle.dump(skeleton, f, protocol=pickle.HIGHEST_PROTOCOL)
+    distrib.barrier("flashy_tpu_ckpt_written")
+    if distrib.is_rank_zero():
+        with write_and_rename(directory / _POINTER, "w") as f:
+            f.write(target)
+    distrib.barrier("flashy_tpu_ckpt_committed")
+    if on_commit is not None:
+        on_commit()
+
+
 def save_state_sharded(state: tp.Any, directory: AnyPath) -> None:
     """Distributed checkpoint: device arrays go through Orbax (each host
     writes only its own shards — no host gather, unlike
@@ -128,32 +164,72 @@ def save_state_sharded(state: tp.Any, directory: AnyPath) -> None:
     disk — the standard A/B tradeoff). ALL processes must call this
     together; the filesystem must be shared across hosts (GCS/NFS).
     """
-    from . import distrib
     directory = Path(directory).absolute()
     skeleton, arrays = _extract_device_arrays(state)
-
-    active = _read_slot_pointer(directory)
-    target = _SLOTS[1] if active == _SLOTS[0] else _SLOTS[0]
-    slot_dir = directory / target
-    if distrib.is_rank_zero():
-        slot_dir.mkdir(parents=True, exist_ok=True)
-        # An aborted previous write to this slot must never look complete.
-        marker = slot_dir / "state.pkl"
-        if marker.exists():
-            marker.unlink()
-    distrib.barrier("flashy_tpu_ckpt_slot")
-
+    target = _prepare_slot(directory)
     if arrays:
         import orbax.checkpoint as ocp
         with ocp.PyTreeCheckpointer() as checkpointer:
-            checkpointer.save(slot_dir / "arrays", arrays, force=True)
-    if distrib.is_rank_zero():
-        with write_and_rename(slot_dir / "state.pkl", "wb") as f:
-            pickle.dump(skeleton, f, protocol=pickle.HIGHEST_PROTOCOL)
-    distrib.barrier("flashy_tpu_ckpt_written")
-    if distrib.is_rank_zero():
-        with write_and_rename(directory / _POINTER, "w") as f:
-            f.write(target)
+            checkpointer.save(directory / target / "arrays", arrays, force=True)
+    _commit_slot(directory, target, skeleton)
+
+
+class AsyncShardedCheckpointer:
+    """Asynchronous variant of `save_state_sharded`.
+
+    `save()` serializes device arrays to host memory and returns while
+    Orbax writes to disk in the background; training continues
+    immediately. The slot's commit marker (skeleton pickle) and the
+    CURRENT pointer flip are deferred to `finalize_pending()` — called
+    automatically at the start of the next `save()` and by `wait()` —
+    so a crash mid-write leaves the previous checkpoint active, exactly
+    like the synchronous A/B scheme. ALL processes must make the same
+    calls in the same order.
+    """
+
+    def __init__(self) -> None:
+        self._checkpointer = None
+        self._pending: tp.Optional[tp.Tuple[Path, str, tp.Any, tp.Any]] = None
+
+    def _orbax(self):
+        if self._checkpointer is None:
+            import orbax.checkpoint as ocp
+            self._checkpointer = ocp.AsyncCheckpointer(
+                ocp.PyTreeCheckpointHandler())
+        return self._checkpointer
+
+    def save(self, state: tp.Any, directory: AnyPath,
+             on_commit: tp.Optional[tp.Callable[[], None]] = None) -> None:
+        """Start an async save. `on_commit` runs (on every rank) once the
+        checkpoint is durable AND active — put cleanup of superseded
+        checkpoints there, never before."""
+        self.finalize_pending()
+        directory = Path(directory).absolute()
+        skeleton, arrays = _extract_device_arrays(state)
+        target = _prepare_slot(directory)
+        if arrays:
+            self._orbax().save(directory / target / "arrays", arrays,
+                               force=True)
+        self._pending = (directory, target, skeleton, on_commit)
+
+    def finalize_pending(self) -> None:
+        """Block until the in-flight save is durable, then commit it."""
+        if self._pending is None:
+            return
+        if self._checkpointer is not None:
+            self._checkpointer.wait_until_finished()
+        directory, target, skeleton, on_commit = self._pending
+        self._pending = None
+        _commit_slot(directory, target, skeleton, on_commit)
+
+    # `wait` reads naturally at call sites that just need durability.
+    wait = finalize_pending
+
+    def close(self) -> None:
+        self.finalize_pending()
+        if self._checkpointer is not None:
+            self._checkpointer.close()
+            self._checkpointer = None
 
 
 def load_state_sharded(directory: AnyPath, placements: tp.Any = None) -> tp.Any:

@@ -36,7 +36,7 @@ def mesh8():
     return make_mesh({"data": 2, "fsdp": 2, "tensor": 2, "seq": 1})
 
 
-def spawn_workers(script_path, num_workers, timeout=600):
+def spawn_workers(script_path, num_workers, timeout=600, extra_env=None):
     """Launch `num_workers` copies of a worker script that rendezvous via
     jax.distributed on localhost; returns [(exit_code, stderr), ...].
 
@@ -67,6 +67,8 @@ def spawn_workers(script_path, num_workers, timeout=600):
                 [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
                 + env.get("PYTHONPATH", "").split(os.pathsep)),
         })
+        if extra_env:
+            env.update(extra_env)
         err_file = tempfile.NamedTemporaryFile("w+", suffix=f".worker{rank}.err",
                                                delete=False)
         err_files.append(err_file)

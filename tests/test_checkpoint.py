@@ -239,3 +239,25 @@ def test_sharded_ab_slots_survive_next_save(tmp_path):
     second_slot = _read_slot_pointer(directory)
     assert first_slot != second_slot  # alternating slots
     assert float(np.asarray(load_state_sharded(directory)["v"])) == 2.0
+
+
+def test_async_sharded_checkpointer_defers_commit(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from flashy_tpu.checkpoint import (AsyncShardedCheckpointer,
+                                       load_state_sharded,
+                                       sharded_checkpoint_exists)
+
+    ckpt = AsyncShardedCheckpointer()
+    directory = tmp_path / "ckpt.sharded"
+    ckpt.save({"v": jnp.float32(1.0)}, directory)
+    # not active until finalized: a crash here must keep the old state
+    assert not sharded_checkpoint_exists(directory)
+    ckpt.wait()
+    assert sharded_checkpoint_exists(directory)
+    assert float(np.asarray(load_state_sharded(directory)["v"])) == 1.0
+
+    # second save: finalizes the first implicitly, commits on wait
+    ckpt.save({"v": jnp.float32(2.0)}, directory)
+    ckpt.wait()
+    assert float(np.asarray(load_state_sharded(directory)["v"])) == 2.0
+    ckpt.close()
