@@ -20,7 +20,10 @@ bench:
 docs:
 	python tools/gendocs.py -o docs/api -p flashy_tpu
 
+native:
+	python tools/build_native.py
+
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests coverage bench docs dist
+.PHONY: default linter tests coverage bench docs native dist

@@ -72,6 +72,36 @@ class ShardedSampler:
         return iter(padded[self.shard_index::self.num_shards].tolist())
 
 
+try:
+    # Optional native collation (`make native`): bulk memcpy with the
+    # GIL released, so loader threads overlap collation with fetches.
+    from . import _collate_ext as _native_collate
+except ImportError:  # pure-python fallback, identical results
+    _native_collate = None
+
+
+def _stack_samples(samples: tp.Sequence[tp.Any]) -> np.ndarray:
+    def as_contiguous(s):
+        a = np.asarray(s)
+        # NOT ascontiguousarray: that promotes 0-d scalars to 1-d.
+        return a if a.flags.c_contiguous else np.ascontiguousarray(a)
+
+    arrays = [as_contiguous(s) for s in samples]
+    first = arrays[0]
+    # The native path is a raw memcpy: only plain native-endian numeric
+    # dtypes qualify (object arrays hold PyObject* that must be
+    # refcounted; byte-swapped data would be copied without conversion),
+    # and ndim must leave room for the new batch dim.
+    native_ok = (_native_collate is not None and len(arrays) > 1
+                 and first.dtype.isnative and not first.dtype.hasobject
+                 and first.ndim < 32
+                 and all(a.dtype == first.dtype and a.shape == first.shape
+                         for a in arrays[1:]))
+    if native_ok:
+        return _native_collate.stack(arrays)
+    return np.stack(arrays)
+
+
 def default_collate(samples: tp.Sequence[tp.Any]) -> tp.Any:
     """Stack a list of samples into a batch, recursively over pytrees."""
     first = samples[0]
@@ -79,7 +109,7 @@ def default_collate(samples: tp.Sequence[tp.Any]) -> tp.Any:
         return {key: default_collate([s[key] for s in samples]) for key in first}
     if isinstance(first, (tuple, list)):
         return type(first)(default_collate(list(group)) for group in zip(*samples))
-    return np.stack([np.asarray(s) for s in samples])
+    return _stack_samples(samples)
 
 
 class DataLoader:

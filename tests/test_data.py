@@ -122,3 +122,56 @@ def test_grain_dataset_compatible():
     batches = list(loader)
     assert len(batches) == 2
     np.testing.assert_allclose(batches[0]["x"][3], np.full(3, 6.0))
+
+
+def test_native_collate_matches_numpy():
+    # built via `make native`; when absent the fallback covers the same
+    # contract, so this test validates whichever path is active plus
+    # (when built) exact agreement between the two.
+    from flashy_tpu.data.loader import _native_collate, _stack_samples
+    rng = np.random.default_rng(0)
+    samples = [rng.normal(size=(5, 7)).astype(np.float32) for _ in range(4)]
+    out = _stack_samples(samples)
+    np.testing.assert_array_equal(out, np.stack(samples))
+    if _native_collate is not None:
+        direct = _native_collate.stack(samples)
+        np.testing.assert_array_equal(direct, np.stack(samples))
+        # int dtypes, odd shapes
+        ints = [np.arange(6, dtype=np.int32).reshape(2, 3) + i for i in range(3)]
+        np.testing.assert_array_equal(_native_collate.stack(ints), np.stack(ints))
+        # scalars-per-sample (0-d arrays)
+        scalars = [np.float64(i) for i in range(3)]
+        np.testing.assert_array_equal(
+            _stack_samples(scalars), np.stack([np.asarray(s) for s in scalars]))
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            _native_collate.stack([np.zeros((2,), np.float32),
+                                   np.zeros((3,), np.float32)])
+
+
+def test_native_collate_mixed_shapes_fall_back():
+    # ragged shapes must raise like np.stack (through the fallback check)
+    import pytest
+    from flashy_tpu.data.loader import _stack_samples
+    with pytest.raises(ValueError):
+        _stack_samples([np.zeros((2,)), np.zeros((3,))])
+
+
+def test_native_collate_rejects_unsafe_dtypes():
+    # object arrays (refcounted pointers) and byte-swapped data must
+    # never reach the raw-memcpy path.
+    from flashy_tpu.data.loader import _native_collate, _stack_samples
+    objs = [np.array([{"a": 1}, {"b": 2}], dtype=object) for _ in range(2)]
+    out = _stack_samples(objs)  # falls back to np.stack
+    assert out.dtype == object and out.shape == (2, 2)
+
+    swapped = [np.arange(4, dtype=np.float32).astype(">f4") for _ in range(2)]
+    out = _stack_samples(swapped)
+    np.testing.assert_array_equal(out.astype(np.float32),
+                                  np.stack(swapped).astype(np.float32))
+    if _native_collate is not None:
+        import pytest as _pytest
+        with _pytest.raises(TypeError):
+            _native_collate.stack(objs)
+        with _pytest.raises(TypeError):
+            _native_collate.stack(swapped)

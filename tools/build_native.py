@@ -1,0 +1,32 @@
+# Build the optional native extensions in place: `make native` (or
+# `python tools/build_native.py`). The package is fully functional
+# without them — pure-python fallbacks engage automatically — so the
+# main install never requires a compiler.
+"""Build flashy_tpu's native extensions in place."""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    import numpy
+    from setuptools import Extension, setup
+
+    root = Path(__file__).resolve().parent.parent
+    setup(
+        name="flashy_tpu_native",
+        script_args=["build_ext", "--inplace"],
+        ext_modules=[
+            Extension(
+                "flashy_tpu.data._collate_ext",
+                [str(root / "flashy_tpu" / "data" / "_collate.c")],
+                include_dirs=[numpy.get_include()],
+                extra_compile_args=["-O3"],
+            ),
+        ],
+    )
+
+
+if __name__ == "__main__":
+    main()
