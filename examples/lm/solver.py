@@ -137,13 +137,18 @@ class LMSolver(flashy_tpu.BaseSolver):
                     {"loss": loss, "grad_norm": optax.global_norm(grads)})
 
         self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._eval_step = jax.jit(lambda params, tokens: loss_fn(params, tokens))
 
     def get_formatter(self, stage_name):
         return flashy_tpu.Formatter({"loss": ".4f", "ppl": ".1f",
                                      "grad_norm": ".2f", "tokens_per_sec": ".0f"})
 
-    def batch_at(self, step: int) -> jax.Array:
-        host = self._stream(self.cfg.batch_size, self.cfg.seq_len, step)
+    def batch_at(self, step: int, eval_set: bool = False) -> jax.Array:
+        # Held-out data: the eval stream draws from a disjoint step range
+        # (the generator is seeded per step, so offsetting never collides
+        # with training steps).
+        host = self._stream(self.cfg.batch_size, self.cfg.seq_len,
+                            step + (1 << 30 if eval_set else 0))
         return shard_batch(jnp.asarray(host), self.mesh,
                            batch_axes=("data", "fsdp"))
 
@@ -167,6 +172,20 @@ class LMSolver(flashy_tpu.BaseSolver):
         metrics["tokens_per_sec"] = tokens_seen / (time.time() - begin)
         return metrics
 
+    def valid(self):
+        """Held-out loss: same loss function, no update, no donation."""
+        average = flashy_tpu.averager()
+        steps = range(self.cfg.get("valid_steps", 4))
+        progress = self.log_progress("valid", steps, updates=2)
+        metrics = {}
+        for index in progress:
+            loss = self._eval_step(self.state["params"],
+                                   self.batch_at(index, eval_set=True))
+            metrics = average({"loss": loss})
+            progress.update(**metrics)
+        metrics["ppl"] = float(np.exp(min(metrics["loss"], 20.0)))
+        return metrics
+
     def generate(self):
         """Sample a continuation with the KV-cache decoder and log it."""
         from flashy_tpu.models import generate as lm_generate
@@ -187,6 +206,8 @@ class LMSolver(flashy_tpu.BaseSolver):
         want_generate = bool(self.cfg.get("generate_every"))
         for epoch in range(self.epoch, self.cfg.epochs + 1):
             self.run_stage("train", self.train)
+            if self.cfg.get("valid_steps", 4):
+                self.run_stage("valid", self.valid)
             if want_generate and epoch % self.cfg.generate_every == 0:
                 self.run_stage("generate", self.generate)
             self.commit()

@@ -56,6 +56,7 @@ def test_lm_example(tmp_path):
                  "generate_every=1")
     history = _history(tmp_path)
     assert "ppl" in history[0]["train"]
+    assert "ppl" in history[0]["valid"]
     assert "generate" in history[0]
 
 
