@@ -57,7 +57,8 @@ class LMSolver(flashy_tpu.BaseSolver):
             scan_layers=scan_layers,
             moe_experts=cfg.model.get("moe_experts", 0),
             moe_top_k=cfg.model.get("moe_top_k", 1),
-            moe_capacity_factor=cfg.model.get("moe_capacity_factor", 1.25))
+            moe_capacity_factor=cfg.model.get("moe_capacity_factor", 1.25),
+            moe_dispatch=cfg.model.get("moe_dispatch", "einsum"))
         self.mesh = make_mesh({k: v for k, v in cfg.mesh.items()})
         self.model = TransformerLM(model_cfg, mesh=self.mesh)
 
@@ -190,11 +191,16 @@ class LMSolver(flashy_tpu.BaseSolver):
         """Sample a continuation with the KV-cache decoder and log it."""
         from flashy_tpu.models import generate as lm_generate
         import time
+        if not hasattr(self, "_generate_jit"):
+            # One compiled decoder reused every epoch; params keep their
+            # mesh shardings through the jit (sharded inference).
+            self._generate_jit = jax.jit(lambda params, prompt, rng: lm_generate(
+                self.model, params, prompt, max_new_tokens=32,
+                temperature=1.0, rng=rng))
         prompt = jnp.asarray(self._stream(2, 16, step=0)[:, :16])
         begin = time.time()
-        out = lm_generate(self.model, self.state["params"], prompt,
-                          max_new_tokens=32, temperature=1.0,
-                          rng=jax.random.PRNGKey(self.epoch))
+        out = self._generate_jit(self.state["params"], prompt,
+                                 jax.random.PRNGKey(self.epoch))
         out = jax.device_get(out)
         self.log_text("generate", "sample",
                       " ".join(str(int(t)) for t in out[0]))

@@ -54,7 +54,11 @@ class MoEMLP(nn.Module):
     #   contractions lower to all-to-alls under expert sharding (use on
     #   expert-parallel meshes); 'sorted': argsort-based scatter/gather,
     #   O(N) dispatch memory instead of O(N*E*C) (use for large
-    #   token-count, replicated-expert training).
+    #   token-count, replicated-expert training); 'dropless': NO capacity
+    #   limit at all — tokens sort by expert and run through a pallas
+    #   grouped matmul (megablocks construction), every token always
+    #   reaches its top-k experts (use for replicated-expert training
+    #   where routing overflow hurts quality).
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -66,32 +70,25 @@ class MoEMLP(nn.Module):
         x_flat = x.reshape(n_tokens, dim)
         if self.dispatch == "sorted":
             return self._sorted_moe(x_flat, capacity).reshape(batch, seq, dim)
+        if self.dispatch == "dropless":
+            return self._dropless_moe(x_flat).reshape(batch, seq, dim)
         if self.dispatch != "einsum":
             raise ValueError(f"unknown dispatch {self.dispatch!r}")
 
         probs, w_up, w_down = self._router_and_weights(x_flat)  # [N, E]
-
-        # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e.
-        density = jnp.mean(probs, axis=0)
-        hard_density = jnp.zeros_like(density)
+        round_experts, round_gates = self._route(probs)         # [k, N]
 
         combine = jnp.zeros((n_tokens, self.num_experts, capacity),
                             dtype=jnp.float32)
-        remaining = probs
         # Slots already handed out per expert by earlier top-k rounds, so
         # a second-choice token never collides with a first-choice one.
         # All slot bookkeeping is integer: a float32 cumsum loses exact
         # integer positions past 2^24 routed tokens, silently colliding
         # capacity slots on very large global batches.
         expert_counts = jnp.zeros((self.num_experts,), jnp.int32)
-        for _ in range(self.top_k):
-            expert_index = jnp.argmax(remaining, axis=-1)      # [N]
-            gate = jnp.take_along_axis(
-                remaining, expert_index[:, None], axis=-1)[:, 0]
+        for expert_index, gate in zip(round_experts, round_gates):
             mask = jax.nn.one_hot(expert_index, self.num_experts,
                                   dtype=jnp.int32)                 # [N, E]
-            hard_density = hard_density + jnp.mean(
-                mask.astype(jnp.float32), axis=0)
             # Position of each token inside its expert's buffer, offset
             # by the slots used in previous rounds.
             position = ((jnp.cumsum(mask, axis=0) - 1)
@@ -102,11 +99,6 @@ class MoEMLP(nn.Module):
             combine = combine + gate[:, None, None] \
                 * mask.astype(jnp.float32)[:, :, None] * slot[:, None, :]
             expert_counts = expert_counts + mask.sum(axis=0)
-            remaining = remaining * (1.0 - jax.nn.one_hot(
-                expert_index, self.num_experts))
-
-        aux = self.num_experts * jnp.sum(density * hard_density / self.top_k)
-        self.sow("losses", "moe_aux", aux)
 
         dispatch = (combine > 0.0).astype(self.dtype)          # [N, E, C]
         # Exposed for tests/debugging (dead-code-eliminated unless the
@@ -126,7 +118,7 @@ class MoEMLP(nn.Module):
     def _router_and_weights(self, x_flat: jax.Array):
         """Single definition of the router (f32 softmax) and the expert
         weight tables [E, ...] (shard dim 0 over 'expert'); shared by
-        both dispatch modes so their parameter trees stay identical."""
+        all dispatch modes so their parameter trees stay identical."""
         probs = jax.nn.softmax(
             nn.Dense(self.num_experts, use_bias=False, dtype=jnp.float32,
                      name="router")(x_flat.astype(jnp.float32)), axis=-1)
@@ -138,6 +130,87 @@ class MoEMLP(nn.Module):
                             jnp.float32)
         return probs, w_up, w_down
 
+    def _route(self, probs: jax.Array):
+        """Sequential top-k argmax routing, shared by all dispatch modes:
+        per round r, each token picks its best not-yet-used expert with
+        the raw softmax probability as the gate. Sows the Switch
+        load-balancing aux loss (eq. 4: E * sum_e f_e * p_e). Returns
+        (expert_index [k, N] int, gate [k, N] f32)."""
+        density = jnp.mean(probs, axis=0)
+        hard_density = jnp.zeros_like(density)
+        remaining = probs
+        expert_ids, gates = [], []
+        for _ in range(self.top_k):
+            expert_index = jnp.argmax(remaining, axis=-1)          # [N]
+            gate = jnp.take_along_axis(
+                remaining, expert_index[:, None], axis=-1)[:, 0]
+            hard_density = hard_density + jnp.mean(
+                jax.nn.one_hot(expert_index, self.num_experts), axis=0)
+            expert_ids.append(expert_index)
+            gates.append(gate)
+            remaining = remaining * (1.0 - jax.nn.one_hot(
+                expert_index, self.num_experts))
+        aux = self.num_experts * jnp.sum(density * hard_density / self.top_k)
+        self.sow("losses", "moe_aux", aux)
+        return jnp.stack(expert_ids), jnp.stack(gates)
+
+    def _dropless_moe(self, x_flat: jax.Array) -> jax.Array:
+        """Dropless dispatch: sort token-expert assignments by expert and
+        run ONE grouped matmul per projection (pallas megablocks `gmm`,
+        differentiable via its custom VJP). No capacity buffers, no
+        dropped tokens; compute is exactly sum_e n_e * d * f. Designed
+        for replicated-expert meshes (expert-sharded `gmm` via
+        group_offset is future work — use dispatch='einsum' on 'expert'-
+        sharded meshes)."""
+        from jax.experimental.pallas.ops.tpu.megablox import ops as megablox
+        n_tokens, dim = x_flat.shape
+        probs, w_up, w_down = self._router_and_weights(x_flat)
+        round_experts, round_gates = self._route(probs)            # [k, N]
+
+        assignment_expert = round_experts.reshape(-1)              # [N*k]
+        assignment_gate = round_gates.reshape(-1)                  # [N*k]
+        assignment_token = jnp.tile(jnp.arange(n_tokens), self.top_k)
+
+        order = jnp.argsort(assignment_expert, stable=True)
+        token_sorted = assignment_token[order]
+        group_sizes = jnp.bincount(assignment_expert,
+                                   length=self.num_experts).astype(jnp.int32)
+
+        x_sorted = x_flat[token_sorted].astype(self.dtype)         # [N*k, D]
+        # The grouped-matmul kernel needs every dim divisible by its
+        # tile. Pad the token dim up to the 128-row tile (pad rows join
+        # the last expert's group; zeros in -> zeros out, and they are
+        # sliced off before the combine); model dims pick the largest
+        # dividing power-of-two tile.
+        m = x_sorted.shape[0]
+        m_pad = (-m) % 128
+        if m_pad:
+            x_sorted = jnp.concatenate(
+                [x_sorted, jnp.zeros((m_pad, dim), self.dtype)], axis=0)
+            group_sizes = group_sizes.at[-1].add(m_pad)
+
+        def tile(size: int) -> int:
+            for candidate in (128, 64, 32, 16, 8, 4, 2, 1):
+                if size % candidate == 0:
+                    return candidate
+            return 1
+
+        interpret = jax.default_backend() == "cpu"
+        hidden = w_up.shape[-1]
+        h = jax.nn.gelu(megablox.gmm(
+            x_sorted, w_up.astype(self.dtype), group_sizes,
+            jnp.float32, (128, tile(dim), tile(hidden)),
+            interpret=interpret).astype(self.dtype))
+        y = megablox.gmm(
+            h, w_down.astype(self.dtype), group_sizes,
+            jnp.float32, (128, tile(hidden), tile(dim)),
+            interpret=interpret)[:m]                               # [N*k, D]
+
+        out = jnp.zeros((n_tokens, dim), jnp.float32)
+        out = out.at[token_sorted].add(
+            y * assignment_gate[order][:, None])
+        return out.astype(self.dtype)
+
     def _sorted_moe(self, x_flat: jax.Array, capacity: int) -> jax.Array:
         """Sorted dispatch: identical routing/keep decisions to the
         einsum path (stable sort preserves token order within an expert,
@@ -147,23 +220,15 @@ class MoEMLP(nn.Module):
         """
         n_tokens, dim = x_flat.shape
         probs, w_up, w_down = self._router_and_weights(x_flat)
+        round_experts, round_gates = self._route(probs)            # [k, N]
 
-        density = jnp.mean(probs, axis=0)
-        hard_density = jnp.zeros_like(density)
         expert_counts = jnp.zeros((self.num_experts,), jnp.int32)
-        remaining = probs
-        # Route every top-k round first; the per-round slot offsets
-        # (expert_counts) make the destinations disjoint, so all rounds
-        # share ONE slab and the expert MLP runs once.
+        # The per-round slot offsets (expert_counts) make the
+        # destinations disjoint, so all rounds share ONE slab and the
+        # expert MLP runs once.
         slab = jnp.zeros((self.num_experts * capacity, dim), self.dtype)
         rounds = []
-        for _ in range(self.top_k):
-            expert_index = jnp.argmax(remaining, axis=-1)          # [N]
-            gate = jnp.take_along_axis(
-                remaining, expert_index[:, None], axis=-1)[:, 0]
-            hard_density = hard_density + jnp.mean(
-                jax.nn.one_hot(expert_index, self.num_experts), axis=0)
-
+        for expert_index, gate in zip(round_experts, round_gates):
             order = jnp.argsort(expert_index, stable=True)
             idx_sorted = expert_index[order]
             # first sorted position of each expert's group
@@ -182,8 +247,6 @@ class MoEMLP(nn.Module):
             expert_counts = expert_counts + jnp.bincount(
                 jnp.where(keep, idx_sorted, self.num_experts),
                 length=self.num_experts + 1)[:-1].astype(jnp.int32)
-            remaining = remaining * (1.0 - jax.nn.one_hot(
-                expert_index, self.num_experts))
 
         # Routing record for tests/debugging (cf. the einsum path's
         # 'dispatch' sow): destinations per round, stacked [top_k, N].
@@ -202,7 +265,4 @@ class MoEMLP(nn.Module):
             y_sorted = flat_out.at[dest].get(
                 mode="fill", fill_value=0).astype(jnp.float32)
             out = out + (y_sorted * gate_kept[:, None])[jnp.argsort(order)]
-
-        aux = self.num_experts * jnp.sum(density * hard_density / self.top_k)
-        self.sow("losses", "moe_aux", aux)
         return out.astype(self.dtype)

@@ -134,3 +134,45 @@ def test_moe_prefill_expert_stream_path():
         nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(tokens))
+
+
+def test_generate_jitted_with_sharded_params():
+    # sharded inference: TP/FSDP-sharded params through the jitted
+    # KV-cache decoder. Greedy token chains can legitimately diverge at
+    # argmax near-ties (TP matmuls reduce in a different order), so the
+    # oracle is the prefill logits within tolerance + a valid decode.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from flashy_tpu.models import transformer_shardings
+    from flashy_tpu.models.decoding import _apply_step, init_cache
+    from flashy_tpu.parallel import make_mesh
+
+    model, params = _model_and_params()
+    mesh = make_mesh({"tensor": 2, "fsdp": 2, "data": 2})
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), transformer_shardings(params),
+        is_leaf=lambda x: isinstance(x, P))
+    sharded = jax.device_put(params, shardings)
+    prompt = jnp.asarray(
+        np.random.default_rng(7).integers(0, 64, (2, 5)), jnp.int32)
+
+    cfg = model.config
+    positions = jnp.broadcast_to(jnp.arange(5, dtype=jnp.int32)[None], (2, 5))
+
+    def prefill_logits(p):
+        cache = init_cache(cfg, 2, 16)
+        logits, _ = _apply_step(model, p, cfg, prompt, positions, cache,
+                                jnp.int32(0))
+        return logits
+
+    ref = prefill_logits(params)
+    out = jax.jit(prefill_logits)(sharded)
+    # activations are bf16 (eps ~8e-3): sharded matmuls reduce in a
+    # different order, so agreement is at bf16 granularity, not f32.
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-2)
+
+    tokens = jax.jit(lambda p, t: generate(model, p, t, max_new_tokens=6))(
+        sharded, prompt)
+    arr = np.asarray(tokens)
+    assert arr.shape == (2, 11)
+    np.testing.assert_array_equal(arr[:, :5], np.asarray(prompt))
+    assert ((arr >= 0) & (arr < 64)).all()
