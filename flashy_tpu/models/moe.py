@@ -158,10 +158,16 @@ class MoEMLP(nn.Module):
         """Dropless dispatch: sort token-expert assignments by expert and
         run ONE grouped matmul per projection (pallas megablocks `gmm`,
         differentiable via its custom VJP). No capacity buffers, no
-        dropped tokens; compute is exactly sum_e n_e * d * f. Designed
-        for replicated-expert meshes (expert-sharded `gmm` via
-        group_offset is future work — use dispatch='einsum' on 'expert'-
-        sharded meshes)."""
+        dropped tokens; compute is exactly sum_e n_e * d * f.
+
+        Replicated-expert meshes only, by a real constraint rather than
+        a TODO: expert-parallel dropless needs a RAGGED all-to-all
+        (per-destination token counts are data-dependent), which XLA's
+        `all_to_all` does not expose — every static-shape EP exchange
+        necessarily reintroduces a capacity bound. On 'expert'-sharded
+        meshes use dispatch='einsum', whose capacity-bounded one-hot
+        contractions are exactly the static a2a pattern SPMD can
+        partition."""
         from jax.experimental.pallas.ops.tpu.megablox import ops as megablox
         n_tokens, dim = x_flat.shape
         probs, w_up, w_down = self._router_and_weights(x_flat)

@@ -112,3 +112,31 @@ def test_sweep_cli_dry_run(capsys):
     assert code == 0
     out = capsys.readouterr().out.strip().splitlines()
     assert out == ["python t.py a=1", "python t.py a=2"]
+
+
+def test_run_plan_streams_and_collects_exit_codes(tmp_path):
+    import io
+    import os
+    import stat
+    from flashy_tpu.launch import run_plan
+
+    # fake ssh: drop the hostname, run the remote line locally
+    shim = tmp_path / "fake_ssh"
+    shim.write_text("#!/bin/sh\nshift\nexec /bin/sh -c \"$1\"\n")
+    shim.chmod(shim.stat().st_mode | stat.S_IXUSR)
+
+    out = tmp_path / "ranks.txt"
+    plan = plan_ssh(
+        ["sh", "-c", f"echo rank $FLASHY_TPU_PROCESS_ID; "
+                     f"echo $FLASHY_TPU_PROCESS_ID >> {out}"],
+        ["hostA", "hostB"])
+    stream = io.StringIO()
+    code = run_plan(plan, ssh_bin=str(shim), stream=stream)
+    assert code == 0
+    assert sorted(out.read_text().split()) == ["0", "1"]
+    text = stream.getvalue()
+    assert "[hostA] rank 0" in text and "[hostB] rank 1" in text
+
+    # a failing host surfaces as the (first) non-zero exit code
+    bad_plan = plan_ssh(["sh", "-c", "exit 3"], ["hostA", "hostB"])
+    assert run_plan(bad_plan, ssh_bin=str(shim), stream=io.StringIO()) == 3
