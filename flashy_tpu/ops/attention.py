@@ -106,19 +106,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                                block_k=block_k, offset=offset)
         v = v_ref[0].astype(jnp.float32)          # [block_k, D]
 
-        m_prev = m_scr[:, 0]                       # [block_q]
-        block_max = scores.max(axis=-1)
+        # All row statistics stay 2-D [block_q, 1] — the Mosaic-friendly
+        # layout (no 1-D vector intermediates).
+        m_prev = m_scr[:, :1]                      # [block_q, 1]
+        block_max = scores.max(axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, block_max)
         alpha = jnp.exp(m_prev - m_new)
-        probs = jnp.exp(scores - m_new[:, None])   # [block_q, block_k]
-        l_new = l_scr[:, 0] * alpha + probs.sum(axis=-1)
-        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+        probs = jnp.exp(scores - m_new)            # [block_q, block_k]
+        l_new = l_scr[:, :1] * alpha + probs.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             probs, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         # Scratch rows are 128 lanes wide (the native f32 tile); the
         # scalar running stats live broadcast across the lane dim.
-        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if causal:
         # Fully-future blocks contribute nothing; skip their MXU work
@@ -129,8 +131,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        denom = jnp.maximum(l_scr[:, 0], 1e-30)
-        o_ref[0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+        denom = jnp.maximum(l_scr[:, :1], 1e-30)   # [block_q, 1]
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
         # logsumexp of each score row; rows with no visible key (can only
         # happen for padding layouts) would be -inf, clamp via denom.
         lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
@@ -157,14 +159,14 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         scores = _block_scores(q_ref, k_ref, qi, ki, scale=scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k, offset=offset)
-        lse = lse_ref[0, :, 0][:, None]            # [block_q, 1]
+        lse = lse_ref[0, :, :1]                    # [block_q, 1]
         probs = jnp.exp(scores - lse)              # [block_q, block_k]
         do = do_ref[0].astype(jnp.float32)         # [block_q, D]
         v = v_ref[0].astype(jnp.float32)           # [block_k, D]
         dp = jax.lax.dot_general(                  # dO V^T [block_q, block_k]
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        delta = delta_ref[0, :, 0][:, None]        # [block_q, 1]
+        delta = delta_ref[0, :, :1]                # [block_q, 1]
         ds = probs * (dp - delta) * scale
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
@@ -201,7 +203,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         scores = _block_scores(q_ref, k_ref, qi, ki, scale=scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k, offset=offset)
-        lse = lse_ref[0, :, 0][:, None]
+        lse = lse_ref[0, :, :1]
         probs = jnp.exp(scores - lse)              # [block_q, block_k]
         do = do_ref[0].astype(jnp.float32)         # [block_q, D]
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(   # P^T dO [block_k, D]
@@ -211,7 +213,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        delta = delta_ref[0, :, 0][:, None]
+        delta = delta_ref[0, :, :1]
         ds = probs * (dp - delta) * scale          # [block_q, block_k]
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(   # dS^T Q [block_k, D]
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
