@@ -290,3 +290,39 @@ def test_ring_attention_block_size_must_divide_t_local():
     ref = _dense_attention(q, k, v, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_grad_accumulation_folds_rng_per_microbatch():
+    from flashy_tpu.parallel import with_grad_accumulation
+
+    # "loss" whose gradient is the random mask itself: identical
+    # randomness across microbatches would make all grad rows equal.
+    def value_and_grad(params, batch, key):
+        mask = jax.random.bernoulli(key, 0.5, batch.shape).astype(jnp.float32)
+        return jnp.zeros(()), {"g": (mask * batch).mean(axis=0)}
+
+    batch = jnp.ones((8, 4))
+    key = jax.random.PRNGKey(0)
+    params = {"g": jnp.zeros(4)}  # grads must mirror params' structure
+
+    folded = with_grad_accumulation(value_and_grad, 4)(
+        params, batch, key)[1]["g"]
+    repeated = with_grad_accumulation(value_and_grad, 4, fold_rng=False)(
+        params, batch, key)[1]["g"]
+
+    # fold_rng=False: every microbatch saw the same mask pattern;
+    # fold_rng=True draws fresh randomness per microbatch, so the two
+    # accumulated gradients (almost surely) differ.
+    assert not np.allclose(np.asarray(folded), np.asarray(repeated))
+
+    # typed keys are detected too
+    typed = with_grad_accumulation(value_and_grad, 4)(
+        params, batch, jax.random.key(0))[1]["g"]
+    assert np.isfinite(np.asarray(typed)).all()
+
+    # non-key args pass through untouched
+    def vg2(params, batch, scale):
+        return jnp.zeros(()), {"g": batch.mean(axis=0) * scale}
+
+    out = with_grad_accumulation(vg2, 4)(params, batch, 3.0)[1]["g"]
+    np.testing.assert_allclose(np.asarray(out), 3.0, rtol=1e-6)
