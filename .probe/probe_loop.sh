@@ -1,0 +1,17 @@
+#!/bin/bash
+# Retry TPU backend probe every 5 min; write status to /root/repo/.probe/status
+while true; do
+  ts=$(date +%s)
+  if timeout 300 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform != 'cpu', d
+print('TPU_UP', [str(x) for x in d])
+" > /root/repo/.probe/last_out 2>/root/repo/.probe/last_err; then
+    echo "UP $ts" > /root/repo/.probe/status
+    exit 0
+  else
+    echo "DOWN $ts" > /root/repo/.probe/status
+  fi
+  sleep 300
+done

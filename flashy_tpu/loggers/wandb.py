@@ -126,8 +126,17 @@ class WandbLogger(ExperimentLogger):
             return None
         try:
             api = wandb.Api()
-            return api.run(f"{project}/{sig}" if project else sig)
-        except Exception:  # CommError, no login, offline, first run
+            # The public API needs a full entity/project/run path; bare
+            # "project/run" 404s on most setups and "run" alone always
+            # raises — fill in the account's defaults.
+            project = project or api.settings.get("project") or "uncategorized"
+            entity = api.default_entity
+            path = f"{entity}/{project}/{sig}" if entity else f"{project}/{sig}"
+            return api.run(path)
+        except Exception as exc:  # CommError, no login, offline, first run
+            logger.info(
+                "wandb: could not recover prior run identity for %s (%s); "
+                "resuming with marker-file identity only.", sig, exc)
             return None
 
     @classmethod

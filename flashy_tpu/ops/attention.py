@@ -28,10 +28,30 @@ import numpy as np
 NEG_INF = -1e30
 
 
+def _guarded_probs(scores: jax.Array, ref: jax.Array) -> jax.Array:
+    """exp(scores - ref) with fully-masked rows forced to zero.
+
+    `ref` is a per-row statistic (running max or logsumexp) that sits at
+    ~NEG_INF when the row saw no visible key. There exp(scores - ref)
+    would be exp(-1e30 - (-1e30)) = exp(0) = 1 — f32 absorbs the log
+    term — silently weighting every masked key equally. The convention
+    here (shared by forward, backward and the ring fallback) is that a
+    query with no visible keys attends to nothing: output and gradients
+    are zero.
+    """
+    return jnp.where(ref > NEG_INF * 0.5, jnp.exp(scores - ref), 0.0)
+
+
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool = False,
                           mask: tp.Optional[jax.Array] = None) -> jax.Array:
-    """Plain attention over [B, T, H, D] arrays; scores in f32."""
+    """Plain attention over [B, T, H, D] arrays; scores in f32.
+
+    Queries with no visible key (possible when `causal` with t_k < t_q,
+    or under a fully-masked `mask` row) produce zero output — the same
+    convention as `flash_attention` — rather than softmax's uniform
+    average over masked keys.
+    """
     scale = 1.0 / np.sqrt(q.shape[-1])
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -41,7 +61,10 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         scores = jnp.where(causal_mask[None, None], scores, NEG_INF)
     if mask is not None:
         scores = jnp.where(mask, scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
+    m = scores.max(axis=-1, keepdims=True)
+    probs = _guarded_probs(scores, m)
+    denom = jnp.maximum(probs.sum(axis=-1, keepdims=True), 1e-30)
+    probs = probs / denom
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
@@ -112,7 +135,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         block_max = scores.max(axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, block_max)
         alpha = jnp.exp(m_prev - m_new)
-        probs = jnp.exp(scores - m_new)            # [block_q, block_k]
+        # _guarded_probs: rows whose running max is still ~NEG_INF have
+        # no visible key in any block so far (mixed q-blocks when
+        # offset < 0); exp(scores - m_new) would be exp(0) = 1 there and
+        # the row would silently average V over masked keys.
+        probs = _guarded_probs(scores, m_new)      # [block_q, block_k]
         l_new = l_scr[:, :1] * alpha + probs.sum(axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             probs, v, (((1,), (0,)), ((), ())),
@@ -160,7 +187,10 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                causal=causal, block_q=block_q,
                                block_k=block_k, offset=offset)
         lse = lse_ref[0, :, :1]                    # [block_q, 1]
-        probs = jnp.exp(scores - lse)              # [block_q, block_k]
+        # Rows with no visible key (offset < 0 cross-attention) carry an
+        # lse at the clamp floor; the forward emitted zeros for them and
+        # the backward must emit zero grads, not exp(0)-weighted ones.
+        probs = _guarded_probs(scores, lse)        # [block_q, block_k]
         do = do_ref[0].astype(jnp.float32)         # [block_q, D]
         v = v_ref[0].astype(jnp.float32)           # [block_k, D]
         dp = jax.lax.dot_general(                  # dO V^T [block_q, block_k]
@@ -204,7 +234,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                causal=causal, block_q=block_q,
                                block_k=block_k, offset=offset)
         lse = lse_ref[0, :, :1]
-        probs = jnp.exp(scores - lse)              # [block_q, block_k]
+        # Same empty-row guard as _flash_dq_kernel.
+        probs = _guarded_probs(scores, lse)        # [block_q, block_k]
         do = do_ref[0].astype(jnp.float32)         # [block_q, D]
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(   # P^T dO [block_k, D]
             probs, do, (((0,), (0,)), ((), ())),

@@ -11,6 +11,7 @@ import logging
 import os
 import time
 import typing as tp
+import uuid
 
 import jax
 import jax.numpy as jnp
@@ -46,8 +47,18 @@ def _store_disk_cache(key: str, best: tp.Tuple[int, int]) -> None:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         disk = _load_disk_cache()
         disk[key] = list(best)
-        with open(path, "w") as f:
-            json.dump(disk, f, indent=0, sort_keys=True)
+        # write-and-rename (as checkpoint.py): concurrent tuners (all
+        # hosts of a pod, cache on shared storage) must never interleave
+        # partial writes — a torn file would silently drop the cache.
+        # uuid, not pid: containerized pod hosts often share pids.
+        tmp = f"{path}.tmp.{uuid.uuid4().hex}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(disk, f, indent=0, sort_keys=True)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     except Exception as exc:  # cache is best-effort
         logger.debug("could not persist tune cache: %s", exc)
 

@@ -8,6 +8,7 @@
 # of `eager_sync_gradients` (flashy/distrib.py:153-190), done by the
 # compiler instead of by hooks.
 """Data-parallel / FSDP step wrapping and batch sharding helpers."""
+import logging
 import typing as tp
 
 import jax
@@ -16,6 +17,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import default_mesh
+
+logger = logging.getLogger(__name__)
 
 BATCH_AXES = ("data", "fsdp")
 
@@ -88,7 +91,7 @@ def shard_params(params: tp.Any, mesh: tp.Optional[Mesh] = None,
 
 def with_grad_accumulation(value_and_grad_fn: tp.Callable,
                            num_microbatches: int, *,
-                           fold_rng: bool = True) -> tp.Callable:
+                           fold_rng: tp.Union[bool, str] = True) -> tp.Callable:
     """Split the batch into microbatches and accumulate gradients.
 
     Wraps `value_and_grad_fn(params, batch, *rest) -> (loss, grads)`
@@ -104,13 +107,18 @@ def with_grad_accumulation(value_and_grad_fn: tp.Callable,
     microbatch index folded in, so dropout (etc.) draws fresh randomness
     per microbatch instead of repeating the same pattern
     `num_microbatches` times. Typed keys (`jax.random.key`) are detected
-    exactly; legacy raw keys are detected as uint32 arrays of shape (2,)
-    — if you pass a NON-key uint32 pair through `rest`, set
-    `fold_rng=False` (or switch to typed keys) to avoid it being
-    misread as a key and rewritten.
+    exactly; legacy raw keys are detected heuristically as uint32 arrays
+    of shape (2,) — a warning is logged once when that heuristic fires,
+    because a NON-key uint32 pair passed through `rest` would be
+    rewritten too. Set `fold_rng="typed"` to fold only exactly-detected
+    typed keys, or `fold_rng=False` to disable folding.
     """
+    if fold_rng not in (True, False, "typed"):
+        raise ValueError(
+            f"fold_rng must be True, False or 'typed', got {fold_rng!r}")
     if num_microbatches <= 1:
         return value_and_grad_fn
+    warned = []  # one warning per wrapped fn, fires at trace time
 
     def fold_rng_keys(tree, index):
         if not fold_rng:
@@ -120,9 +128,20 @@ def with_grad_accumulation(value_and_grad_fn: tp.Callable,
             dtype = getattr(leaf, "dtype", None)
             if dtype is None:
                 return leaf
-            is_key = jnp.issubdtype(dtype, jax.dtypes.prng_key) or (
-                dtype == jnp.uint32 and getattr(leaf, "shape", None) == (2,))
-            return jax.random.fold_in(leaf, index) if is_key else leaf
+            if jnp.issubdtype(dtype, jax.dtypes.prng_key):
+                return jax.random.fold_in(leaf, index)
+            if (fold_rng != "typed"
+                    and dtype == jnp.uint32
+                    and getattr(leaf, "shape", None) == (2,)):
+                if not warned:
+                    warned.append(True)
+                    logger.warning(
+                        "with_grad_accumulation: folding a raw (2,)-uint32 "
+                        "array as a legacy PRNG key; if this is not a key, "
+                        "pass fold_rng='typed' (and use jax.random.key) or "
+                        "fold_rng=False.")
+                return jax.random.fold_in(leaf, index)
+            return leaf
 
         return jax.tree_util.tree_map(fold, tree)
 

@@ -75,7 +75,7 @@ def _block_forward(q, k, v, *, causal_diag: bool):
         mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
         scores = jnp.where(mask[None, None], scores, NEG_INF)
     m = scores.max(axis=-1)                          # [B, H, Tq]
-    probs = jnp.exp(scores - m[..., None])
+    probs = _attn._guarded_probs(scores, m[..., None])
     denom = jnp.maximum(probs.sum(axis=-1), 1e-30)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs / denom[..., None],
                      v.astype(jnp.float32))
@@ -112,7 +112,10 @@ def _block_backward(q, k, v, out_global, do, lse_rows, delta_rows, *,
     if causal_diag:
         mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
         scores = jnp.where(mask[None, None], scores, NEG_INF)
-    probs = jnp.exp(scores - lse_rows[..., None])     # [B, H, Tq, Tk]
+    # Empty-row guard (mirrors the pallas backward kernels): rows whose
+    # forward lse hit the clamp floor have no visible key and must get
+    # zero probs/gradients.
+    probs = _attn._guarded_probs(scores, lse_rows[..., None])  # [B,H,Tq,Tk]
     do_f = do.astype(jnp.float32)
     dv = jnp.einsum("bhqk,bqhd->bkhd", probs, do_f)
     dp = jnp.einsum("bqhd,bkhd->bhqk", do_f, v.astype(jnp.float32))

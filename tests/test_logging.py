@@ -141,8 +141,12 @@ def test_wandb_resume_reuses_prior_run_identity(xp, monkeypatch):
         config = {"lr": 0.25}
 
     class FakeApi:
+        # the public API resolves runs by entity/project/run_id
+        default_entity = "my-team"
+        settings = {}
+
         def run(self, path):
-            assert path == f"proj/{xp.sig}"
+            assert path == f"my-team/proj/{xp.sig}"
             return FakePriorRun()
 
     fake = types.SimpleNamespace(
@@ -165,6 +169,31 @@ def test_wandb_resume_reuses_prior_run_identity(xp, monkeypatch):
     assert call["name"] == "prior-name"
     assert call["config"] == {"lr": 0.25}
     assert call["resume"] == "allow"
+
+
+def test_wandb_prior_run_lookup_without_project(xp, monkeypatch):
+    # project=None must still resolve a full entity/project path (the
+    # bare-sig lookup always raised on the public API, silently dropping
+    # resume identity).
+    import types
+    from flashy_tpu.loggers import wandb as wandb_mod
+
+    paths = []
+
+    class FakeApi:
+        default_entity = "my-team"
+        settings = {"project": "default-proj"}
+
+        def run(self, path):
+            paths.append(path)
+            raise RuntimeError("no such run")
+
+    fake = types.SimpleNamespace(Api=FakeApi, init=lambda **kw: None)
+    monkeypatch.setattr(wandb_mod, "wandb", fake)
+    monkeypatch.setattr(wandb_mod, "_WANDB_AVAILABLE", True)
+
+    assert wandb_mod.WandbLogger._lookup_prior_run(xp.sig, None) is None
+    assert paths == [f"my-team/default-proj/{xp.sig}"]
 
 
 def test_wandb_first_run_tolerates_api_failure(xp, monkeypatch):

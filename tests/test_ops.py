@@ -100,6 +100,61 @@ def test_flash_backward_cross_length():
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("block_q", [16, 32])
+def test_flash_empty_rows_zero(block_q):
+    # t_k < t_q with causal: offset = t_k - t_q < 0, so queries
+    # i < t_q - t_k see NO keys at all. Convention: they attend to
+    # nothing — zero output, zero gradients. Regressions this guards:
+    #  * forward: a mixed q-block (block_q=32 here spans 16 empty + 16
+    #    visible rows) has m_new = NEG_INF for empty rows, so unguarded
+    #    probs = exp(0) = 1 silently averaged V over masked keys;
+    #  * backward: the clamped lse makes unguarded probs = exp(0) = 1,
+    #    producing garbage dq/dk/dv for those rows.
+    # block_q=16 additionally covers the aligned case where the empty
+    # rows form a whole skipped block.
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.normal(size=(1, 32, 2, 16)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 16, 2, 16)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(1, 16, 2, 16)).astype(np.float32))
+    n_empty = q.shape[1] - k.shape[1]
+
+    def flash_loss(q, k, v):
+        return (flash_attention(q, k, v, causal=True,
+                                block_q=block_q, block_k=16) ** 2).sum()
+
+    def dense_loss(q, k, v):
+        return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
+
+    out = flash_attention(q, k, v, causal=True, block_q=block_q, block_k=16)
+    np.testing.assert_array_equal(np.asarray(out[:, :n_empty]), 0.0)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    ga = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    gb = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for g in ga:
+        assert np.isfinite(np.asarray(g)).all()
+    # empty q rows contribute nothing: dq there is exactly zero
+    np.testing.assert_array_equal(np.asarray(ga[0][:, :n_empty]), 0.0)
+    for a, b in zip(ga, gb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_dense_attention_fully_masked_rows_zero():
+    # the dense path shares the zeros convention for fully-masked rows
+    q, k, v = _rand_qkv((1, 8, 2, 16), seed=10)
+    mask = np.ones((1, 1, 8, 8), bool)
+    mask[:, :, 3] = False                 # query 3 sees nothing
+    out = dot_product_attention(q, k, v, mask=jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(out[:, 3]), 0.0)
+    assert np.isfinite(np.asarray(out)).all()
+    # other rows unaffected by the masked row's existence
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out[:, :3]), np.asarray(ref[:, :3]),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_flash_backward_asymmetric_blocks_non_causal():
     q, k, v = _rand_qkv((2, 64, 2, 32), seed=7)
 
