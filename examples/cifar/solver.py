@@ -20,10 +20,11 @@ from flashy_tpu.parallel import make_mesh, wrap
 
 
 class Solver(flashy_tpu.BaseSolver):
-    def __init__(self, cfg, loaders):
+    def __init__(self, cfg, loaders, is_real: bool = False):
         super().__init__()
         self.cfg = cfg
         self.loaders = loaders
+        self.is_real = is_real
         model_fn = {"resnet18": resnet18, "resnet50": resnet50}[cfg.model]
         self.model = model_fn(num_classes=10)
 
@@ -76,13 +77,21 @@ class Solver(flashy_tpu.BaseSolver):
         model = self.model
 
         def step(state, batch):
+            # The valid loader is padded/masked (pad_to_even): batches
+            # carry a "valid" 0/1 row mask. Sums (not means) come back so
+            # the host can weight by the true valid count — padding rows
+            # contribute nothing and sharded eval equals unsharded eval
+            # exactly.
             logits = model.apply(
                 {"params": state["params"], "batch_stats": state["batch_stats"]},
                 batch["image"], train=False)
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, batch["label"]).mean()
-            acc = (logits.argmax(-1) == batch["label"]).mean()
-            return state, {"loss": loss, "acc": acc}
+            valid = batch["valid"]
+            loss_vec = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["label"])
+            correct = (logits.argmax(-1) == batch["label"]).astype(jnp.float32)
+            return state, {"loss_sum": (loss_vec * valid).sum(),
+                           "acc_sum": (correct * valid).sum(),
+                           "n": valid.sum()}
 
         return step
 
@@ -98,17 +107,32 @@ class Solver(flashy_tpu.BaseSolver):
         average = flashy_tpu.averager()
         progress = self.log_progress(self.current_stage, loader, updates=5)
         metrics = {}
-        count = 0
+        count = 0.0
         begin = time.time()
-        batches = prefetch_to_device(progress, size=2, mesh=self.mesh,
+        if train:
+            source = progress
+        else:
+            # fold the validity mask into the batch so it shards with it
+            source = ({**batch, "valid": mask.astype(np.float32)}
+                      for batch, mask in progress)
+        batches = prefetch_to_device(source, size=2, mesh=self.mesh,
                                      batch_axes=("data",))
         for index, batch in enumerate(batches):
             if self.cfg.max_batches is not None and index >= self.cfg.max_batches:
                 break
             self.state, step_metrics = step_fn(self.state, batch)
-            metrics = average(step_metrics, weight=len(batch["label"]))
+            if train:
+                weight = len(batch["label"])
+                metrics = average(step_metrics, weight=weight)
+            else:
+                sums = jax.device_get(step_metrics)
+                weight = float(sums["n"])
+                if weight:
+                    metrics = average({"loss": sums["loss_sum"] / weight,
+                                       "acc": sums["acc_sum"] / weight},
+                                      weight=weight)
             progress.update(**metrics)
-            count += len(batch["label"])
+            count += weight
         jax.block_until_ready(self.state["params"])
         metrics["images_per_sec"] = count / max(time.time() - begin, 1e-9)
         if not train:
@@ -126,3 +150,24 @@ class Solver(flashy_tpu.BaseSolver):
             self.run_stage("train", self._run_epoch, train=True)
             self.run_stage("valid", self._run_epoch, train=False)
             self.commit()
+        self._report_target_acc()
+
+    def _report_target_acc(self):
+        """BASELINE.md #2: to-baseline accuracy, judged on REAL data only."""
+        target = self.cfg.get("target_acc")
+        if not target or not self.history:
+            return
+        acc = self.history[-1].get("valid", {}).get("acc")
+        if acc is None:
+            return
+        if not self.is_real:
+            self.logger.info(
+                "valid acc %.2f%% on SYNTHETIC data; target_acc=%.2f%% only "
+                "applies to real CIFAR-10 (set data_root / FLASHY_TPU_CIFAR)",
+                100 * acc, 100 * target)
+        elif acc >= target:
+            self.logger.info("baseline accuracy REACHED: %.2f%% >= %.2f%%",
+                             100 * acc, 100 * target)
+        else:
+            self.logger.warning("baseline accuracy MISSED: %.2f%% < %.2f%%",
+                                100 * acc, 100 * target)

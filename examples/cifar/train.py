@@ -10,18 +10,21 @@ from .solver import Solver
 
 
 def get_solver(cfg):
-    x_train, y_train, x_test, y_test, is_real = load_cifar10()
+    x_train, y_train, x_test, y_test, is_real = load_cifar10(
+        cfg.get("data_root"))
     train_set = CifarDataset(x_train, y_train, augment=True)
     valid_set = CifarDataset(x_test, y_test)
     loaders = {
         # shuffle=True -> equal per-process shards (training); eval uses
-        # the strided no-replication shard.
+        # padded/masked shards so every process runs the same number of
+        # eval steps (the step has in-graph collectives) while metrics
+        # stay exactly equal to unsharded eval.
         "train": distrib.loader(train_set, batch_size=cfg.batch_size,
                                 shuffle=True, num_workers=4),
         "valid": distrib.loader(valid_set, batch_size=cfg.batch_size,
-                                num_workers=4),
+                                pad_to_even=True, num_workers=4),
     }
-    solver = Solver(cfg, loaders)
+    solver = Solver(cfg, loaders, is_real=is_real)
     solver.logger.info("CIFAR-10 data: %s", "real" if is_real else "synthetic")
     return solver
 

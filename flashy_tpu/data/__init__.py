@@ -1,3 +1,4 @@
 # Data pipeline: per-process sharded loading + host→HBM prefetch.
 # flake8: noqa
-from .loader import DataLoader, ShardedSampler, StridedShard, prefetch_to_device
+from .loader import (DataLoader, ShardedSampler, StridedShard, masked_mean,
+                     prefetch_to_device)

@@ -128,22 +128,57 @@ class DataLoader:
         collate_fn: list-of-samples -> batch pytree.
         num_workers: threads fetching samples concurrently (0 = inline).
         seed: shuffling seed.
+        pad_to_even: padded/masked eval mode. Plain strided eval shards
+            differ in size by one, so per-process step counts diverge and
+            an eval step containing in-graph collectives deadlocks the
+            pod. With `pad_to_even=True` every process yields the SAME
+            number of full-size batches (derived from the GLOBAL dataset
+            length, so identical everywhere by construction) as
+            `(batch, valid_mask)` pairs: `valid_mask` is a bool
+            [batch_size] marking real samples; padding rows repeat a
+            shard sample and must be masked out of metrics. Exact metric
+            parity with single-process eval via::
+
+                count = 0.0
+                for batch, mask in loader:
+                    per_sample = eval_step(params, batch)   # [B] each
+                    means, weight = masked_mean(per_sample, mask)
+                    metrics = average(means, weight)        # averager()
+                    count += weight
+                metrics = distrib.average_metrics(metrics, count)
+
+            Incompatible with `shuffle=True` (training pads via the
+            sampler already); `drop_last` is ignored (all batches are
+            full by construction).
     """
 
     def __init__(self, dataset, batch_size: int = 1, *, shuffle: bool = False,
                  num_shards: int = 1, shard_index: int = 0,
                  drop_last: tp.Optional[bool] = None,
                  collate_fn: tp.Callable = default_collate,
-                 num_workers: int = 0, seed: int = 0):
+                 num_workers: int = 0, seed: int = 0,
+                 pad_to_even: bool = False):
+        if pad_to_even and shuffle:
+            raise ValueError("pad_to_even is an eval mode; the training "
+                             "path (shuffle=True) already pads via its "
+                             "sampler")
         self.batch_size = batch_size
         self.collate_fn = collate_fn
         self.num_workers = num_workers
         self.drop_last = shuffle if drop_last is None else drop_last
+        self.pad_to_even = pad_to_even
         self.sampler: tp.Optional[ShardedSampler] = None
         if shuffle:
             self.dataset = dataset
             self.sampler = ShardedSampler(len(dataset), shard_index, num_shards,
                                           shuffle=True, seed=seed)
+        elif pad_to_even:
+            # keep the raw dataset: padding may need a sample even when
+            # this process's strided shard is empty (len(dataset) <
+            # num_shards).
+            self.dataset = dataset
+            self._num_shards = num_shards
+            self._shard_index = shard_index
         elif num_shards > 1:
             self.dataset = StridedShard(dataset, shard_index, num_shards)
         else:
@@ -160,12 +195,43 @@ class DataLoader:
         return iter(range(len(self.dataset)))
 
     def __len__(self) -> int:
+        if self.pad_to_even:
+            per_shard = -(-len(self.dataset) // self._num_shards)
+            return -(-per_shard // self.batch_size)
         n = len(self.sampler) if self.sampler is not None else len(self.dataset)
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
+    def _iter_padded(self) -> tp.Iterator[tp.Tuple[tp.Any, np.ndarray]]:
+        own = list(range(self._shard_index, len(self.dataset),
+                         self._num_shards))
+        valid = len(own)
+        total = len(self) * self.batch_size
+        pad_src = own or [0]  # empty shard: any sample, fully masked
+        padded = own + [pad_src[i % len(pad_src)]
+                        for i in range(total - valid)]
+        starts = range(0, total, self.batch_size)
+
+        def fetch(start, sample_map):
+            idxs = padded[start:start + self.batch_size]
+            samples = list(sample_map(self.dataset.__getitem__, idxs))
+            mask = np.arange(start, start + self.batch_size) < valid
+            return self.collate_fn(samples), mask
+
+        if self.num_workers > 0:
+            executor = ThreadPoolExecutor(max_workers=self.num_workers)
+            try:
+                yield from (fetch(s, executor.map) for s in starts)
+            finally:
+                executor.shutdown(wait=False)
+        else:
+            yield from (fetch(s, map) for s in starts)
+
     def __iter__(self) -> tp.Iterator[tp.Any]:
+        if self.pad_to_even:
+            yield from self._iter_padded()
+            return
         indices = list(self._indices())
         batches = [indices[i:i + self.batch_size]
                    for i in range(0, len(indices), self.batch_size)]
@@ -186,6 +252,28 @@ class DataLoader:
         else:
             for batch_indices in batches:
                 yield self.collate_fn([self.dataset[i] for i in batch_indices])
+
+
+def masked_mean(per_sample: tp.Dict[str, tp.Any], mask: np.ndarray
+                ) -> tp.Tuple[tp.Dict[str, float], float]:
+    """Mean of per-sample metrics over the valid rows of a padded batch.
+
+    `per_sample` maps names to [batch_size] arrays (one value per
+    sample); `mask` is the bool validity mask yielded by a
+    `pad_to_even` loader. Returns `(means, weight)` where `weight` is
+    the number of valid samples — feed both to `utils.averager()` and
+    the final count to `distrib.average_metrics` for exact parity with
+    unsharded eval. A fully-padded batch returns zero means with zero
+    weight (it then contributes nothing to the running average).
+    """
+    weight = float(np.asarray(mask).sum())
+    denom = max(weight, 1.0)
+    means = {
+        key: float((np.asarray(value, dtype=np.float64)
+                    * np.asarray(mask)).sum() / denom)
+        for key, value in per_sample.items()
+    }
+    return means, weight
 
 
 def prefetch_to_device(iterator: tp.Iterable[tp.Any], size: int = 2,

@@ -431,8 +431,12 @@ def loader(dataset, *args, shuffle: bool = False, klass=None, **kwargs):
     Training (`shuffle=True`) uses an epoch-seeded shuffling sampler that
     pads to equal per-process length (DistributedSampler role); eval uses
     a strided shard with no sample replication — the exact split
-    semantics of reference flashy/distrib.py:227-243. See
-    `flashy_tpu.data.DataLoader` for prefetch options.
+    semantics of reference flashy/distrib.py:227-243. If the eval step
+    runs in-graph collectives, pass `pad_to_even=True` to get equal
+    per-process step counts with `(batch, valid_mask)` pairs instead
+    (see `flashy_tpu.data.DataLoader` / `flashy_tpu.data.masked_mean`);
+    plain strided shards may differ in length by one and deadlock the
+    pod. See `flashy_tpu.data.DataLoader` for prefetch options.
     """
     from .data import DataLoader
     klass = klass or DataLoader

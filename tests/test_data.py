@@ -175,3 +175,75 @@ def test_native_collate_rejects_unsafe_dtypes():
             _native_collate.stack(objs)
         with _pytest.raises(TypeError):
             _native_collate.stack(swapped)
+
+
+def test_loader_pad_to_even_equal_steps_exact_coverage():
+    import pytest
+    from flashy_tpu.data import masked_mean
+
+    # 13 samples, 4 shards, batch 2: sizes would be [4, 3, 3, 3] strided;
+    # padded mode must give every shard the same number of full batches.
+    data = SquareDataset(13)
+    loaders = [DataLoader(data, 2, num_shards=4, shard_index=r,
+                          pad_to_even=True) for r in range(4)]
+    assert len({len(ld) for ld in loaders}) == 1
+    assert len(loaders[0]) == 2  # ceil(ceil(13/4)/2)
+
+    seen = []
+    for ld in loaders:
+        batches = list(ld)
+        assert len(batches) == len(ld)
+        for batch, mask in batches:
+            assert batch["x"].shape == (2, 3)  # always full, static
+            assert mask.shape == (2,) and mask.dtype == bool
+            seen.extend(int(y) for y, m in zip(batch["y"], mask) if m)
+    # valid samples cover the dataset exactly once
+    assert sorted(seen) == list(range(13))
+
+    # masked mean over a padded batch ignores the padding rows
+    means, weight = masked_mean({"y": np.array([5.0, 7.0])},
+                                np.array([True, False]))
+    assert means == {"y": 5.0} and weight == 1.0
+
+    # dataset smaller than the shard count: empty shards still yield the
+    # same number of (fully masked) batches instead of hanging siblings
+    tiny = SquareDataset(2)
+    loaders = [DataLoader(tiny, 2, num_shards=4, shard_index=r,
+                          pad_to_even=True) for r in range(4)]
+    assert len({len(ld) for ld in loaders}) == 1 and len(loaders[0]) == 1
+    valid = []
+    for ld in loaders:
+        ((batch, mask),) = list(ld)
+        assert batch["x"].shape == (2, 3)
+        valid.extend(int(y) for y, m in zip(batch["y"], mask) if m)
+    assert sorted(valid) == [0, 1]
+
+    with pytest.raises(ValueError):
+        DataLoader(data, 2, shuffle=True, pad_to_even=True)
+
+
+def test_loader_pad_to_even_matches_unsharded_eval():
+    from flashy_tpu.data import masked_mean
+    from flashy_tpu.utils import averager
+
+    # exact metric parity: sharded masked eval == single-process eval
+    data = SquareDataset(11)
+    expected = np.mean([float(i) for i in range(11)])
+
+    num = den = 0.0
+    for r in range(3):
+        ld = DataLoader(data, 4, num_shards=3, shard_index=r,
+                        pad_to_even=True)
+        avg = averager()
+        metrics, count = {}, 0.0
+        for batch, mask in ld:
+            means, weight = masked_mean(
+                {"y": batch["y"].astype(np.float64)}, mask)
+            metrics = avg(means, weight)
+            count += weight
+        # per-process weighted contribution (what average_metrics does
+        # across ranks with count as the weight)
+        if count:
+            num += metrics["y"] * count
+            den += count
+    assert abs(num / den - expected) < 1e-12

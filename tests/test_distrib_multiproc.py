@@ -148,3 +148,56 @@ def test_multiprocess_sharded_checkpoint(tmp_path):
     results = spawn_workers(script, 2, extra_env=env)
     for rank, (code, err) in enumerate(results):
         assert code == 0, f"worker {rank} failed:\n{err[-2000:]}"
+
+
+EVAL_WORKER_SCRIPT = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from flashy_tpu import distrib
+    from flashy_tpu.data import DataLoader, masked_mean
+    from flashy_tpu.utils import averager
+
+    distrib.init()
+    rank, ws = distrib.rank(), distrib.world_size()
+
+    class Dataset:
+        # length 13 does NOT divide ws=4: strided shards are [4,3,3,3],
+        # so a per-batch collective would deadlock without padding
+        def __len__(self):
+            return 13
+
+        def __getitem__(self, i):
+            return {"v": np.float64(i * i)}
+
+    loader = distrib.loader(Dataset(), batch_size=2, pad_to_even=True)
+    avg = averager()
+    metrics, count = {}, 0.0
+    n_steps = 0
+    for batch, mask in loader:
+        # a collective EVERY batch: any step-count divergence across
+        # processes hangs here (caught by the spawn timeout)
+        distrib.barrier()
+        means, weight = masked_mean({"v": batch["v"]}, mask)
+        metrics = avg(means, weight)
+        count += weight
+        n_steps += 1
+    assert n_steps == len(loader), (n_steps, len(loader))
+    final = distrib.average_metrics(metrics or {"v": 0.0}, count)
+    expected = np.mean([float(i * i) for i in range(13)])
+    assert abs(final["v"] - expected) < 1e-9, (final, expected)
+    distrib.barrier()
+""")
+
+
+@pytest.mark.slow
+def test_multiprocess_padded_eval_matches_single_process(tmp_path):
+    # Eval-shard semantics (SURVEY §7 "hard part"): equal per-process
+    # step counts via pad_to_even, a collective every batch, and EXACT
+    # metric equality with unsharded eval despite 13 % 4 != 0.
+    script = tmp_path / "worker_eval.py"
+    script.write_text(EVAL_WORKER_SCRIPT)
+    results = spawn_workers(script, NUM_WORKERS, timeout=300)
+    for rank, (code, err) in enumerate(results):
+        assert code == 0, f"worker {rank} failed:\n{err[-2000:]}"

@@ -100,3 +100,41 @@ def test_lm_solver_pipelined_loss_parity(tmp_path):
             _, metrics = solver._train_step(solver.state, solver.batch_at(0))
             losses[name] = float(jax.device_get(metrics["loss"]))
     assert abs(losses["plain"] - losses["piped"]) < 1e-3, losses
+
+
+def test_cifar_ingestion_override(tmp_path, monkeypatch):
+    import pickle
+    import numpy as np
+    import pytest
+    from examples.cifar.data import load_cifar10
+
+    # explicit root that doesn't resolve must raise, not silently fall
+    # back to synthetic (that would fake the accuracy-to-baseline run)
+    with pytest.raises(FileNotFoundError):
+        load_cifar10(str(tmp_path / "missing"))
+    monkeypatch.setenv("FLASHY_TPU_CIFAR", str(tmp_path / "missing"))
+    with pytest.raises(FileNotFoundError):
+        load_cifar10()
+    monkeypatch.delenv("FLASHY_TPU_CIFAR")
+
+    # a directory in the on-disk format torchvision unpacks
+    # (cifar-10-batches-py pickles with b"data" [N, 3072] and b"labels")
+    root = tmp_path / "cifar-10-batches-py"
+    root.mkdir()
+    rng = np.random.default_rng(0)
+    for name, n in [(f"data_batch_{i}", 4) for i in range(1, 6)] + [
+            ("test_batch", 6)]:
+        entry = {b"data": rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+                 b"labels": rng.integers(0, 10, n).tolist()}
+        with open(root / name, "wb") as f:
+            pickle.dump(entry, f)
+
+    x_train, y_train, x_test, y_test, is_real = load_cifar10(str(root))
+    assert is_real
+    assert x_train.shape == (20, 32, 32, 3) and y_train.shape == (20,)
+    assert x_test.shape == (6, 32, 32, 3)
+    assert x_train.dtype == np.float32 and 0.0 <= x_train.min() <= x_train.max() <= 1.0
+
+    # env var route finds the same directory
+    monkeypatch.setenv("FLASHY_TPU_CIFAR", str(root))
+    assert load_cifar10()[4] is True
