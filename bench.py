@@ -29,10 +29,18 @@ import time
 
 REFERENCE_IMAGES_PER_SEC = 3000.0  # single-GPU torch reference ballpark
 
-# A healthy backend initializes in 30-90s; 240s gives ample headroom
-# while leaving most of the driver's bench budget for the measurements
-# themselves when the tunnel is wedged (it hangs rather than erroring).
-PROBE_TIMEOUT_S = float(os.environ.get("FLASHY_TPU_BENCH_PROBE_TIMEOUT", "240"))
+# A healthy backend initializes in 30-90s. The budget is spent on
+# SEVERAL spaced attempts (the tunnel serves one client and can wedge
+# then recover): each attempt gets up to 90s, with a short pause
+# between, until the budget runs out.
+PROBE_BUDGET_S = float(os.environ.get("FLASHY_TPU_BENCH_PROBE_TIMEOUT", "240"))
+PROBE_ATTEMPT_S = 90.0
+PROBE_PAUSE_S = 15.0
+
+# Partial results land here as each leg completes, so a bench killed
+# mid-run (driver timeout, tunnel collapse) still leaves its numbers.
+PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_PARTIAL.json")
 
 # Peak bf16 matmul FLOP/s per chip, by device_kind substring (public
 # cloud.google.com/tpu/docs numbers).
@@ -79,6 +87,152 @@ def probe_backend(timeout: float):
         return json.loads(proc.stdout.strip().splitlines()[-1]), None
     except Exception as exc:  # noqa: BLE001
         return None, f"probe output unparsable: {exc}"
+
+
+def probe_backend_with_retries(budget: float):
+    """Spend `budget` seconds on spaced probe attempts.
+
+    Returns (info, error, n_attempts); a tunnel that comes back halfway
+    through the budget is caught by a later attempt instead of the
+    whole bench writing itself off on the first hang.
+    """
+    deadline = time.monotonic() + budget
+    error = "no probe attempt fit in the budget"
+    attempts = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining < 20 and attempts:
+            return None, error, attempts
+        info, error = probe_backend(min(PROBE_ATTEMPT_S, max(remaining, 20)))
+        attempts += 1
+        if info is not None:
+            return info, None, attempts
+        log(f"probe attempt {attempts} failed: {error}")
+        if deadline - time.monotonic() < PROBE_PAUSE_S + 20:
+            return None, error, attempts
+        time.sleep(PROBE_PAUSE_S)
+
+
+def bench_smoke(jax, on_tpu: bool):
+    """Fast first leg (<60s incl. compiles): prove the pallas kernels
+    lower under Mosaic on the live backend and capture one
+    flash-vs-dense fwd+bwd timing, one tiny LM train step, and one tiny
+    CIFAR train step. Runs BEFORE the full legs so a brief tunnel
+    window still yields on-chip evidence (VERDICT r2: zero TPU numbers
+    two rounds running)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flashy_tpu.models import TransformerConfig, TransformerLM, resnet18
+    from flashy_tpu.ops import attention as attn_mod
+
+    out = {}
+    rng = np.random.default_rng(0)
+    b, t, h, d = (4, 1024, 8, 64) if on_tpu else (1, 256, 2, 32)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.bfloat16)
+               for _ in range(3))
+
+    def fwd_bwd(fn):
+        return jax.jit(jax.grad(lambda q, k, v: fn(q, k, v, causal=True)
+                                .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+
+    def time_once(grad_fn):
+        jax.block_until_ready(grad_fn(q, k, v))  # compile + 1st run
+        begin = time.perf_counter()
+        jax.block_until_ready(grad_fn(q, k, v))
+        return time.perf_counter() - begin
+
+    dense_t = time_once(fwd_bwd(attn_mod.dot_product_attention))
+    out["dense_ms"] = round(dense_t * 1e3, 3)
+    if on_tpu:
+        flash_t = time_once(fwd_bwd(attn_mod.flash_attention))
+        out["flash_ms"] = round(flash_t * 1e3, 3)
+        out["flash_speedup"] = round(dense_t / flash_t, 2)
+        out["mosaic_ok"] = True  # a real (non-interpret) lowering ran
+    out["attn_shape"] = [b, t, h, d]
+
+    # one tiny LM train step (matmul/softmax/optimizer path end-to-end)
+    cfg = TransformerConfig(vocab_size=512, dim=128, num_layers=2,
+                            num_heads=4, attention="flash" if on_tpu else "dense")
+    model = TransformerLM(cfg)
+    tokens = jnp.asarray(rng.integers(0, 512, (2, 256)), jnp.int32)
+    params = {"params": model.init(jax.random.PRNGKey(0), tokens)["params"]}
+    optim = optax.adamw(1e-4)
+
+    def lm_step(params, opt_state, tokens):
+        def loss_fn(variables):
+            logits = model.apply(variables, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optim.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step = jax.jit(lm_step)
+    p2, o2, loss = step(params, optim.init(params), tokens)
+    jax.block_until_ready(loss)
+    begin = time.perf_counter()
+    _, _, loss = step(p2, o2, tokens)
+    jax.block_until_ready(loss)
+    out["lm_step_ms"] = round((time.perf_counter() - begin) * 1e3, 2)
+    assert np.isfinite(float(loss))
+
+    # one tiny CIFAR train step (conv/batchnorm path)
+    rmodel = resnet18(num_classes=10)
+    variables = rmodel.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                            train=False)
+    images = jnp.asarray(rng.normal(size=(32, 32, 32, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, 32), jnp.int32)
+
+    def cifar_step(params, batch_stats):
+        def loss_fn(p):
+            logits, mutated = rmodel.apply(
+                {"params": p, "batch_stats": batch_stats},
+                images, train=True, mutable=["batch_stats"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean(), mutated
+        (loss, mutated), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        return loss, grads
+
+    cstep = jax.jit(cifar_step)
+    loss, grads = cstep(variables["params"], variables["batch_stats"])
+    jax.block_until_ready(loss)
+    begin = time.perf_counter()
+    loss, grads = cstep(variables["params"], variables["batch_stats"])
+    jax.block_until_ready(loss)
+    out["cifar_step_ms"] = round((time.perf_counter() - begin) * 1e3, 2)
+    log(f"smoke: dense {out['dense_ms']}ms"
+        + (f", flash {out['flash_ms']}ms" if "flash_ms" in out else "")
+        + f", lm step {out['lm_step_ms']}ms, cifar step {out['cifar_step_ms']}ms")
+    return out
+
+
+def bench_host_sync(jax, on_tpu: bool):
+    """Per-call cost of staging a model-sized tree device→host→device —
+    the built-in overhead of the host-mediated `average_tensors` /
+    `sync_model` parity path that the in-graph `wrap()` route avoids
+    entirely (distrib.py warns after repeated large calls; this leg
+    gives the docs a number to quote)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = 25_000_000 if on_tpu else 2_000_000  # ~100 / 8 MB f32
+    tree = {f"w{i}": jnp.ones((n // 8,), jnp.float32) for i in range(8)}
+    jax.block_until_ready(tree)
+    reps = 5
+    begin = time.perf_counter()
+    for _ in range(reps):
+        host = {k: np.asarray(jax.device_get(v)) for k, v in tree.items()}
+        back = {k: jnp.asarray(v) for k, v in host.items()}
+        jax.block_until_ready(back)
+    elapsed = (time.perf_counter() - begin) / reps
+    mib = n * 4 / 2**20
+    log(f"host-sync staging: {elapsed * 1e3:.1f} ms per {mib:.0f} MiB round "
+        f"trip ({mib / 1024 / elapsed:.2f} GiB/s)")
+    return {"stage_ms_per_roundtrip": round(elapsed * 1e3, 1),
+            "tree_mib": round(mib, 1),
+            "gib_per_sec": round(mib / 1024 / elapsed, 2)}
 
 
 def bench_cifar(jax, on_tpu: bool):
@@ -375,18 +529,30 @@ def bench_all_reduce(jax):
             "payload_mib": 64}
 
 
+def _persist_partial(extra: dict) -> None:
+    """Refresh BENCH_PARTIAL.json after every leg (atomic rename)."""
+    try:
+        tmp = PARTIAL_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(extra, f, indent=1, sort_keys=True)
+        os.replace(tmp, PARTIAL_PATH)
+    except OSError as exc:  # never let persistence kill the bench
+        log(f"could not persist partial results: {exc}")
+
+
 def main() -> None:
-    info, probe_error = probe_backend(PROBE_TIMEOUT_S)
+    info, probe_error, attempts = probe_backend_with_retries(PROBE_BUDGET_S)
     import jax
     from flashy_tpu.utils import pin_platform
     if info is None:
-        log(f"TPU probe failed: {probe_error}; falling back to CPU")
+        log(f"TPU probe failed after {attempts} attempt(s): {probe_error}; "
+            "falling back to CPU")
         jax.config.update("jax_platforms", "cpu")
         platform, device_kind = "cpu", "cpu-fallback"
     else:
         pin_platform()
         platform, device_kind = info["platform"], info["device_kind"]
-        log(f"backend up: {info}")
+        log(f"backend up after {attempts} attempt(s): {info}")
     on_tpu = platform not in ("cpu",)
 
     peak = None
@@ -398,14 +564,22 @@ def main() -> None:
 
     extra = {"platform": platform, "device_kind": device_kind,
              "n_devices": len(jax.devices()),
+             "probe_attempts": attempts,
              "peak_bf16_tflops": peak / 1e12 if peak else None}
     if probe_error:
         extra["backend_error"] = probe_error
+    # persist the probe/platform metadata immediately: a first leg that
+    # hangs (the tunnel's documented failure mode) must not erase the
+    # evidence that the backend came up
+    _persist_partial(extra)
 
-    for name, fn in (("cifar", lambda: bench_cifar(jax, on_tpu)),
+    # smoke runs FIRST: on-chip kernel evidence within the first minute
+    for name, fn in (("smoke", lambda: bench_smoke(jax, on_tpu)),
+                     ("cifar", lambda: bench_cifar(jax, on_tpu)),
                      ("lm", lambda: bench_lm(jax, on_tpu, peak)),
                      ("attention", lambda: bench_flash_attention(jax, on_tpu)),
                      ("gan", lambda: bench_gan(jax, on_tpu)),
+                     ("host_sync", lambda: bench_host_sync(jax, on_tpu)),
                      ("all_reduce", lambda: bench_all_reduce(jax))):
         try:
             extra[name] = fn()
@@ -413,6 +587,7 @@ def main() -> None:
             import traceback
             traceback.print_exc(file=sys.stderr)
             extra[name] = {"error": str(exc)[:300]}
+        _persist_partial(extra)
 
     headline = extra.get("cifar", {}).get("images_per_sec_per_chip")
     payload = {

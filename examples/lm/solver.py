@@ -62,11 +62,16 @@ class LMSolver(flashy_tpu.BaseSolver):
         self.mesh = make_mesh({k: v for k, v in cfg.mesh.items()})
         self.model = TransformerLM(model_cfg, mesh=self.mesh)
 
-        # Params are identical across attention implementations, so init
-        # through the dense twin: cheap, shape-unconstrained, no
-        # collectives at init time.
+        # Params are identical across attention implementations and MoE
+        # dispatch modes (all share _router_and_weights), so init
+        # through a dense/replicated twin: cheap, shape-unconstrained,
+        # no collectives at init time (dropless_ep would shard_map).
+        init_dispatch = cfg.model.get("moe_dispatch", "einsum")
+        if init_dispatch == "dropless_ep":
+            init_dispatch = "einsum"
         init_model = TransformerLM(
-            dataclasses_replace(model_cfg, attention="dense"))
+            dataclasses_replace(model_cfg, attention="dense",
+                                moe_dispatch=init_dispatch))
         tokens0 = jnp.zeros((1, min(cfg.seq_len, 128)), jnp.int32)
         variables = init_model.init(jax.random.PRNGKey(0), tokens0)
         # keep only real parameters — init may also return sown

@@ -297,8 +297,9 @@ def average_tensors(tree: tp.Any, *, method: str = "auto") -> tp.Any:
         return tree
     floats, treedef = _partition_floats(tree)
     _check_tree_sizes(floats)
+    total = sum(leaf.nbytes for leaf in floats)
+    _note_host_sync(total)
     if method == "auto":
-        total = sum(leaf.nbytes for leaf in floats)
         method = "reduce" if total >= REDUCE_MIN_BYTES else "allgather"
     if method == "reduce":
         averaged: tp.Any = _reduce_mean_across_processes(floats)
@@ -309,6 +310,34 @@ def average_tensors(tree: tp.Any, *, method: str = "auto") -> tp.Any:
     else:
         raise ValueError(f"unknown method {method!r}")
     return _combine_floats(tree, treedef, averaged)
+
+
+_host_sync_big_calls = 0
+
+
+def _note_host_sync(total_bytes: int) -> None:
+    """One-time performance warning for the slow-by-construction path.
+
+    `average_tensors` stages every leaf device→host→device; the reduce
+    method fixes wire bytes but not the host staging. A couple of large
+    calls are normal (init broadcast, checkpoint averaging) — but a
+    model-sized tree moving through here repeatedly is the reference's
+    sync_model-per-step workflow, which on TPU regresses badly versus
+    the in-graph route (`distrib.wrap` / `parallel.wrap`, where XLA
+    keeps the gradient reduction on ICI, fused with the step). Warn
+    once, on the third large call.
+    """
+    global _host_sync_big_calls
+    if total_bytes < REDUCE_MIN_BYTES:
+        return
+    _host_sync_big_calls += 1
+    if _host_sync_big_calls == 3:
+        logger.warning(
+            "average_tensors has now moved a >%d-byte tree through host "
+            "memory %d times; if this is a per-step gradient/model sync, "
+            "switch to the in-graph data-parallel path (distrib.wrap) — "
+            "host staging serializes transfers the mesh path overlaps.",
+            REDUCE_MIN_BYTES, _host_sync_big_calls)
 
 
 def broadcast_tensors(tree: tp.Any, src: int = 0) -> tp.Any:

@@ -58,7 +58,14 @@ class MoEMLP(nn.Module):
     #   limit at all — tokens sort by expert and run through a pallas
     #   grouped matmul (megablocks construction), every token always
     #   reaches its top-k experts (use for replicated-expert training
-    #   where routing overflow hurts quality).
+    #   where routing overflow hurts quality); 'dropless_ep': the
+    #   expert-parallel hybrid — explicit capacity-bounded all-to-all
+    #   between the mesh's expert shards (requires `mesh`), grouped
+    #   matmul on each shard's local expert slab (see
+    #   parallel/moe_ep.py for the exchange construction).
+    mesh: tp.Any = None        # required by 'dropless_ep'
+    expert_axis: str = "expert"
+    token_axes: tp.Tuple[str, ...] = ("data",)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -72,6 +79,8 @@ class MoEMLP(nn.Module):
             return self._sorted_moe(x_flat, capacity).reshape(batch, seq, dim)
         if self.dispatch == "dropless":
             return self._dropless_moe(x_flat).reshape(batch, seq, dim)
+        if self.dispatch == "dropless_ep":
+            return self._dropless_ep_moe(x_flat).reshape(batch, seq, dim)
         if self.dispatch != "einsum":
             raise ValueError(f"unknown dispatch {self.dispatch!r}")
 
@@ -131,28 +140,20 @@ class MoEMLP(nn.Module):
         return probs, w_up, w_down
 
     def _route(self, probs: jax.Array):
-        """Sequential top-k argmax routing, shared by all dispatch modes:
-        per round r, each token picks its best not-yet-used expert with
-        the raw softmax probability as the gate. Sows the Switch
+        """Sequential top-k argmax routing, shared by all dispatch modes
+        (one implementation: `parallel.moe_ep._topk_route`, which the
+        EP exchange also uses — parity across modes depends on it): per
+        round r, each token picks its best not-yet-used expert with the
+        raw softmax probability as the gate. Sows the Switch
         load-balancing aux loss (eq. 4: E * sum_e f_e * p_e). Returns
         (expert_index [k, N] int, gate [k, N] f32)."""
+        from ..parallel.moe_ep import _topk_route
+        expert_ids, gates, hard_density = _topk_route(
+            probs, self.num_experts, self.top_k)
         density = jnp.mean(probs, axis=0)
-        hard_density = jnp.zeros_like(density)
-        remaining = probs
-        expert_ids, gates = [], []
-        for _ in range(self.top_k):
-            expert_index = jnp.argmax(remaining, axis=-1)          # [N]
-            gate = jnp.take_along_axis(
-                remaining, expert_index[:, None], axis=-1)[:, 0]
-            hard_density = hard_density + jnp.mean(
-                jax.nn.one_hot(expert_index, self.num_experts), axis=0)
-            expert_ids.append(expert_index)
-            gates.append(gate)
-            remaining = remaining * (1.0 - jax.nn.one_hot(
-                expert_index, self.num_experts))
         aux = self.num_experts * jnp.sum(density * hard_density / self.top_k)
         self.sow("losses", "moe_aux", aux)
-        return jnp.stack(expert_ids), jnp.stack(gates)
+        return expert_ids, gates
 
     def _dropless_moe(self, x_flat: jax.Array) -> jax.Array:
         """Dropless dispatch: sort token-expert assignments by expert and
@@ -164,11 +165,11 @@ class MoEMLP(nn.Module):
         a TODO: expert-parallel dropless needs a RAGGED all-to-all
         (per-destination token counts are data-dependent), which XLA's
         `all_to_all` does not expose — every static-shape EP exchange
-        necessarily reintroduces a capacity bound. On 'expert'-sharded
-        meshes use dispatch='einsum', whose capacity-bounded one-hot
-        contractions are exactly the static a2a pattern SPMD can
-        partition."""
-        from jax.experimental.pallas.ops.tpu.megablox import ops as megablox
+        necessarily reintroduces a capacity bound — `dispatch=
+        'dropless_ep'` is exactly that hybrid. On GSPMD expert-sharded
+        meshes without the explicit exchange, dispatch='einsum' remains
+        the static a2a pattern SPMD can partition."""
+        from ..parallel.moe_ep import _grouped_mlp
         n_tokens, dim = x_flat.shape
         probs, w_up, w_down = self._router_and_weights(x_flat)
         round_experts, round_gates = self._route(probs)            # [k, N]
@@ -183,39 +184,33 @@ class MoEMLP(nn.Module):
                                    length=self.num_experts).astype(jnp.int32)
 
         x_sorted = x_flat[token_sorted].astype(self.dtype)         # [N*k, D]
-        # The grouped-matmul kernel needs every dim divisible by its
-        # tile. Pad the token dim up to the 128-row tile (pad rows join
-        # the last expert's group; zeros in -> zeros out, and they are
-        # sliced off before the combine); model dims pick the largest
-        # dividing power-of-two tile.
-        m = x_sorted.shape[0]
-        m_pad = (-m) % 128
-        if m_pad:
-            x_sorted = jnp.concatenate(
-                [x_sorted, jnp.zeros((m_pad, dim), self.dtype)], axis=0)
-            group_sizes = group_sizes.at[-1].add(m_pad)
-
-        def tile(size: int) -> int:
-            for candidate in (128, 64, 32, 16, 8, 4, 2, 1):
-                if size % candidate == 0:
-                    return candidate
-            return 1
-
-        interpret = jax.default_backend() == "cpu"
-        hidden = w_up.shape[-1]
-        h = jax.nn.gelu(megablox.gmm(
-            x_sorted, w_up.astype(self.dtype), group_sizes,
-            jnp.float32, (128, tile(dim), tile(hidden)),
-            interpret=interpret).astype(self.dtype))
-        y = megablox.gmm(
-            h, w_down.astype(self.dtype), group_sizes,
-            jnp.float32, (128, tile(hidden), tile(dim)),
-            interpret=interpret)[:m]                               # [N*k, D]
+        y = _grouped_mlp(x_sorted, w_up, w_down, group_sizes, self.dtype)
 
         out = jnp.zeros((n_tokens, dim), jnp.float32)
         out = out.at[token_sorted].add(
             y * assignment_gate[order][:, None])
         return out.astype(self.dtype)
+
+    def _dropless_ep_moe(self, x_flat: jax.Array) -> jax.Array:
+        """Expert-parallel dropless hybrid: routing and parameters are
+        declared here (identical tree to the other modes); the
+        capacity-bounded shard exchange + per-shard grouped matmul live
+        in `parallel.moe_ep.ep_dropless_moe`. The aux loss comes back
+        from the exchange (densities pmean'd over all tokens) and is
+        sown under the same name as the other modes."""
+        from ..parallel.moe_ep import ep_dropless_moe
+        if self.mesh is None:
+            raise ValueError("dispatch='dropless_ep' needs the mesh "
+                             "(MoEMLP(mesh=...)); use 'dropless' for "
+                             "replicated-expert training")
+        probs, w_up, w_down = self._router_and_weights(x_flat)
+        out, aux = ep_dropless_moe(
+            x_flat, probs, w_up, w_down, mesh=self.mesh,
+            num_experts=self.num_experts, top_k=self.top_k,
+            capacity_factor=self.capacity_factor, axis=self.expert_axis,
+            token_axes=self.token_axes, dtype=self.dtype)
+        self.sow("losses", "moe_aux", aux)
+        return out
 
     def _sorted_moe(self, x_flat: jax.Array, capacity: int) -> jax.Array:
         """Sorted dispatch: identical routing/keep decisions to the

@@ -43,7 +43,8 @@ class TransformerConfig:
     moe_experts: int = 0         # >0 replaces the MLP with a routed MoE
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
-    moe_dispatch: str = "einsum"  # einsum | sorted | dropless (see MoEMLP)
+    moe_dispatch: str = "einsum"  # einsum | sorted | dropless |
+                                  # dropless_ep (see MoEMLP)
     scan_layers: bool = False    # nn.scan-stack the blocks: params get a
                                  # leading [num_layers] dim (O(1) compile
                                  # time in depth; enables 'pipe' sharding
@@ -137,7 +138,7 @@ class Block(nn.Module):
             x = x + MoEMLP(dim=cfg.dim, hidden=cfg.dim * cfg.mlp_ratio,
                            num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
                            capacity_factor=cfg.moe_capacity_factor,
-                           dispatch=cfg.moe_dispatch,
+                           dispatch=cfg.moe_dispatch, mesh=self.mesh,
                            dtype=cfg.dtype, name="moe")(normed)
         else:
             x = x + MLPBlock(cfg, name="mlp")(normed, train)

@@ -420,6 +420,17 @@ if _PALLAS_AVAILABLE:
     _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _dividing_block(t: int) -> int:
+    """Largest multiple of the 128-lane width (≤ 512, the VMEM comfort
+    zone for the f32 score tile) that divides `t`, or 0 when `t` is not
+    128-aligned (the caller then keeps its non-dividing block and falls
+    back to the dense path)."""
+    for size in (512, 384, 256, 128):
+        if t % size == 0:
+            return size
+    return 0
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, *, block_q: int = 256,
                     block_k: int = 256,
@@ -428,14 +439,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     Forward and backward are pallas kernels (O(T) sequence memory; the
     backward recomputes P blockwise from the forward's logsumexp — the
-    FlashAttention-2 decomposition). Falls back to
-    `dot_product_attention` when pallas cannot run (non-TPU backend
-    without interpret mode) or when T is not divisible by the block
-    sizes. Block sizes are clamped to the sequence length.
+    FlashAttention-2 decomposition). Block sizes are clamped to the
+    sequence length; when the requested block does not divide T, the
+    largest dividing multiple of 128 (up to 512) is used instead, so
+    e.g. T=384 runs the kernel at 384 rather than falling back. Only
+    when no 128-multiple divides T (T not 128-aligned), or pallas
+    cannot run at all (non-TPU backend without interpret mode), does it
+    fall back to `dot_product_attention`.
     """
     t_q, t_k = q.shape[1], k.shape[1]
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_k)
+    if t_q % block_q:
+        block_q = _dividing_block(t_q) or block_q
+    if t_k % block_k:
+        block_k = _dividing_block(t_k) or block_k
     if not _PALLAS_AVAILABLE or t_q % block_q or t_k % block_k:
         return dot_product_attention(q, k, v, causal=causal)
     backend = jax.default_backend()

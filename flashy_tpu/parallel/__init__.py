@@ -9,3 +9,4 @@ from .data_parallel import (wrap, shard_batch, replicate, fsdp_sharding,
                             shard_params, with_grad_accumulation)
 from .ring import ring_attention, ring_self_attention
 from .pipeline import pipeline
+from .moe_ep import ep_dropless_moe

@@ -42,13 +42,19 @@ def _use_pallas(t_q: int, t_k: int, block: int = 128) -> bool:
 def _block_sizes(t_q: int, t_k: int) -> tp.Tuple[int, int]:
     """Largest kernel tile that DIVIDES each length (the kernels' grid
     floor-divides, so a non-dividing tile would silently drop rows —
-    t_local=384 with a 256 tile covers only rows 0-255)."""
+    t_local=384 with a 256 tile covers only rows 0-255).
+
+    Candidates are every multiple of the 128-lane width up to 512 (the
+    VMEM comfort zone for the [block_q, block_k] f32 score tile —
+    `ops.attention._dividing_block`, the one candidate list shared with
+    `flash_attention`'s auto-pick), so any 128-aligned t_local gets a
+    pallas tile — e.g. 384 runs at 384 instead of falling back to plain
+    XLA as the {512,256,128} set did; the worst 128-aligned case (640,
+    1664, ...) still runs at 128."""
 
     def pick(t: int) -> int:
-        for size in (512, 256, 128):
-            if t % size == 0:
-                return size
-        return t  # t < 128: only reachable in interpret mode
+        # 0 = not 128-aligned: t < 128 only reachable in interpret mode
+        return _attn._dividing_block(t) or t
 
     return pick(t_q), pick(t_k)
 

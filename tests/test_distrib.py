@@ -77,3 +77,35 @@ def test_loader_delegates(tmp_path):
     batches = list(loader)
     assert len(batches) == 5
     assert batches[0].shape == (2, 2)
+
+
+def test_host_sync_warning_after_repeated_big_trees(monkeypatch, caplog):
+    import logging
+    from flashy_tpu import distrib
+
+    # simulate distribution so average_tensors takes the sync path while
+    # stubbing the actual collective (single process here)
+    monkeypatch.setattr(distrib, "is_distributed", lambda: True)
+    monkeypatch.setattr(distrib, "_reduce_mean_across_processes",
+                        lambda floats: floats)
+    monkeypatch.setattr(distrib, "_host_sync_big_calls", 0)
+    big = {"w": np.zeros(400_000, np.float32)}  # > REDUCE_MIN_BYTES
+
+    with caplog.at_level(logging.WARNING, logger="flashy_tpu.distrib"):
+        for _ in range(2):
+            distrib.average_tensors(big)
+        assert not any("average_tensors" in r.message for r in caplog.records)
+        distrib.average_tensors(big)  # third large call -> one warning
+        distrib.average_tensors(big)  # no repeat
+    hits = [r for r in caplog.records if "distrib.wrap" in r.message]
+    assert len(hits) == 1
+
+    # small metric-sized trees never warn
+    monkeypatch.setattr(distrib, "_host_sync_big_calls", 0)
+    monkeypatch.setattr(distrib, "all_reduce", lambda v, op="sum": v)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="flashy_tpu.distrib"):
+        for _ in range(5):
+            distrib.average_tensors({"loss": np.zeros(3, np.float32)},
+                                    method="reduce")
+    assert not caplog.records

@@ -228,3 +228,22 @@ def test_tune_flash_blocks_sweeps_and_caches(tmp_path, monkeypatch):
 def test_tune_flash_blocks_cpu_returns_default():
     from flashy_tpu.ops.tuning import tune_flash_blocks
     assert tune_flash_blocks(1, 256, 2, 16) == (256, 256)
+
+
+def test_flash_auto_block_for_384():
+    # 384 = 3*128 divides none of the default blocks; the auto-pick must
+    # run the kernel at 384 instead of falling back to dense, and a
+    # non-128-aligned length must still fall back (same numbers either
+    # way — this pins the selection logic).
+    from flashy_tpu.ops.attention import _dividing_block
+    assert _dividing_block(384) == 384
+    assert _dividing_block(640) == 128
+    assert _dividing_block(768) == 384
+    assert _dividing_block(1024) == 512
+    assert _dividing_block(200) == 0
+
+    q, k, v = _rand_qkv((1, 384, 2, 16), seed=13)
+    out = flash_attention(q, k, v, causal=True)  # default 256 blocks
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)

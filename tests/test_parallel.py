@@ -274,9 +274,13 @@ def test_ring_attention_pallas_block_path(causal):
 
 def test_ring_attention_block_size_must_divide_t_local():
     # regression: t_local=384 is 128-aligned but not 256-divisible; the
-    # kernel tile must fall back to 128 or rows 256-383 silently vanish.
+    # kernel tile must divide t or rows 256-383 silently vanish. Since
+    # round 3 the candidate set includes every 128-multiple up to 512,
+    # so 384 gets a single whole-sequence tile instead of 3x128.
     from flashy_tpu.parallel.ring import _block_sizes, _use_pallas
     assert _use_pallas(384, 384)
+    assert _block_sizes(384, 384) == (384, 384)
+    assert _block_sizes(640, 1024) == (128, 512)
     bq, bk = _block_sizes(384, 384)
     assert 384 % bq == 0 and 384 % bk == 0
 
