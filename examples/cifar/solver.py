@@ -17,6 +17,7 @@ from flashy_tpu import distrib
 from flashy_tpu.data import prefetch_to_device
 from flashy_tpu.models import resnet18, resnet50
 from flashy_tpu.parallel import make_mesh, wrap
+from flashy_tpu.utils import device_sync
 
 
 class Solver(flashy_tpu.BaseSolver):
@@ -133,7 +134,7 @@ class Solver(flashy_tpu.BaseSolver):
                                       weight=weight)
             progress.update(**metrics)
             count += weight
-        jax.block_until_ready(self.state["params"])
+        device_sync(self.state["params"])  # real completion: block_until_ready can misreport on proxy backends
         metrics["images_per_sec"] = count / max(time.time() - begin, 1e-9)
         if not train:
             self.log_image("valid", "sample",

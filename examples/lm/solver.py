@@ -17,6 +17,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import flashy_tpu
 from flashy_tpu.models import TransformerConfig, TransformerLM, transformer_shardings
 from flashy_tpu.parallel import make_mesh, shard_batch
+from flashy_tpu.utils import device_sync
 
 
 def synthetic_token_stream(vocab_size: int, seed: int = 0):
@@ -173,7 +174,7 @@ class LMSolver(flashy_tpu.BaseSolver):
             metrics = average(step_metrics)
             tokens_seen += self.cfg.batch_size * self.cfg.seq_len
             progress.update(**metrics)
-        jax.block_until_ready(self.state["params"])
+        device_sync(self.state["params"])  # real completion: block_until_ready can misreport on proxy backends
         metrics["ppl"] = float(np.exp(min(metrics["loss"], 20.0)))
         metrics["tokens_per_sec"] = tokens_seen / (time.time() - begin)
         return metrics

@@ -64,12 +64,18 @@ def _store_disk_cache(key: str, best: tp.Tuple[int, int]) -> None:
 
 
 def _time_call(fn: tp.Callable[[], tp.Any], reps: int = 5) -> float:
+    # device_sync, not block_until_ready: the latter misreports on
+    # remote/proxy backends (axon tunnel) and the sweep would rank
+    # candidates by dispatch overhead instead of kernel time. One
+    # readback per measurement; the dispatches in between pipeline.
+    from ..utils import device_sync
+
     out = fn()
-    jax.block_until_ready(out)
+    device_sync(out)
     begin = time.perf_counter()
     for _ in range(reps):
         out = fn()
-    jax.block_until_ready(out)
+    device_sync(out)
     return (time.perf_counter() - begin) / reps
 
 
