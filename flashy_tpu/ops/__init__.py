@@ -1,4 +1,4 @@
 # Compute ops: attention kernels (pallas flash attention on TPU, XLA
 # fallback elsewhere) and fused building blocks. flake8: noqa
 from .attention import dot_product_attention, flash_attention
-from .tuning import tune_flash_blocks
+from .tuning import lookup_tuned_blocks, tune_flash_blocks

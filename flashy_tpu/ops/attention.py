@@ -432,24 +432,34 @@ def _dividing_block(t: int) -> int:
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = False, *, block_q: int = 256,
-                    block_k: int = 256,
+                    causal: bool = False, *,
+                    block_q: tp.Optional[int] = None,
+                    block_k: tp.Optional[int] = None,
                     interpret: tp.Optional[bool] = None) -> jax.Array:
     """Flash attention over [B, T, H, D]; pallas on TPU, XLA elsewhere.
 
     Forward and backward are pallas kernels (O(T) sequence memory; the
     backward recomputes P blockwise from the forward's logsumexp — the
-    FlashAttention-2 decomposition). Block sizes are clamped to the
-    sequence length; when the requested block does not divide T, the
-    largest dividing multiple of 128 (up to 512) is used instead, so
-    e.g. T=384 runs the kernel at 384 rather than falling back. Only
-    when no 128-multiple divides T (T not 128-aligned), or pallas
-    cannot run at all (non-TPU backend without interpret mode), does it
-    fall back to `dot_product_attention`.
+    FlashAttention-2 decomposition). Block sizes default to a tuned
+    table when one exists for this (device, shape) — populated by
+    `ops.tune_flash_blocks` / the bench / `tools/tpu_validate.py` —
+    else 256; they are clamped to the sequence length, and when the
+    requested block does not divide T, the largest dividing multiple
+    of 128 (up to 512) is used instead, so e.g. T=384 runs the kernel
+    at 384 rather than falling back. Only when no 128-multiple divides
+    T (T not 128-aligned), or pallas cannot run at all (non-TPU
+    backend without interpret mode), does it fall back to
+    `dot_product_attention`.
     """
     t_q, t_k = q.shape[1], k.shape[1]
-    block_q = min(block_q, t_q)
-    block_k = min(block_k, t_k)
+    if block_q is None and block_k is None and t_q == t_k:
+        from .tuning import lookup_tuned_blocks
+        tuned = lookup_tuned_blocks(q.shape[0], t_q, q.shape[2], q.shape[3],
+                                    causal=causal, dtype=q.dtype)
+        if tuned is not None:
+            block_q, block_k = tuned
+    block_q = min(block_q or 256, t_q)
+    block_k = min(block_k or 256, t_k)
     if t_q % block_q:
         block_q = _dividing_block(t_q) or block_q
     if t_k % block_k:

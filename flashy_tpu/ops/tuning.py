@@ -41,6 +41,40 @@ def _load_disk_cache() -> tp.Dict[str, tp.List[int]]:
         return {}
 
 
+def _make_key(batch: int, seq_len: int, heads: int, head_dim: int,
+              causal: bool, dtype: tp.Any, include_backward: bool) -> tp.Tuple:
+    return (jax.devices()[0].device_kind, batch, seq_len, heads, head_dim,
+            causal, str(jnp.dtype(dtype)), include_backward)
+
+
+def lookup_tuned_blocks(batch: int, seq_len: int, heads: int, head_dim: int, *,
+                        causal: bool = True, dtype: tp.Any = jnp.bfloat16,
+                        include_backward: bool = True
+                        ) -> tp.Optional[tp.Tuple[int, int]]:
+    """Cache-only lookup of tuned (block_q, block_k) — NEVER sweeps.
+
+    `flash_attention` calls this at trace time when no explicit block
+    sizes were requested, so a tuning table persisted once (bench run,
+    `tools/tpu_validate.py`, or an explicit `tune_flash_blocks` call)
+    speeds up every later model at the same shape with zero per-run
+    cost. Returns None on a cache miss (caller keeps its defaults).
+    """
+    try:
+        key = _make_key(batch, seq_len, heads, head_dim, causal, dtype,
+                        include_backward)
+    except Exception:  # devices not initialized / no backend
+        return None
+    if key in _cache:
+        return _cache[key]
+    disk_key = "/".join(str(part) for part in key)
+    disk = _load_disk_cache()
+    if disk_key in disk:
+        best = tuple(disk[disk_key])
+        _cache[key] = best  # type: ignore[assignment]
+        return best  # type: ignore[return-value]
+    return None
+
+
 def _store_disk_cache(key: str, best: tp.Tuple[int, int]) -> None:
     path = _cache_path()
     try:
@@ -95,9 +129,8 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int, *,
     """
     from .attention import flash_attention
 
-    device_kind = jax.devices()[0].device_kind
-    key = (device_kind, batch, seq_len, heads, head_dim, causal,
-           str(jnp.dtype(dtype)), include_backward)
+    key = _make_key(batch, seq_len, heads, head_dim, causal, dtype,
+                    include_backward)
     if key in _cache:
         return _cache[key]
     disk_key = "/".join(str(part) for part in key)

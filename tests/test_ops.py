@@ -247,3 +247,46 @@ def test_flash_auto_block_for_384():
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_lookup_tuned_blocks_cache_only(tmp_path, monkeypatch):
+    # lookup never sweeps: a cache miss is None, a seeded disk cache hits
+    import flashy_tpu.ops.tuning as tuning
+    monkeypatch.setenv("FLASHY_TPU_TUNE_CACHE", str(tmp_path / "cache.json"))
+    tuning._cache.clear()
+    assert tuning.lookup_tuned_blocks(1, 256, 2, 16) is None
+
+    key = tuning._make_key(1, 256, 2, 16, True, jnp.bfloat16, True)
+    tuning._store_disk_cache("/".join(str(p) for p in key), (128, 256))
+    tuning._cache.clear()
+    assert tuning.lookup_tuned_blocks(1, 256, 2, 16) == (128, 256)
+    # memory-cached after the disk hit
+    monkeypatch.setenv("FLASHY_TPU_TUNE_CACHE", str(tmp_path / "other.json"))
+    assert tuning.lookup_tuned_blocks(1, 256, 2, 16) == (128, 256)
+
+
+def test_flash_attention_uses_tuned_blocks(tmp_path, monkeypatch):
+    # flash_attention with default block sizes picks up the tuned table
+    import flashy_tpu.ops.attention as attention
+    import flashy_tpu.ops.tuning as tuning
+    monkeypatch.setenv("FLASHY_TPU_TUNE_CACHE", str(tmp_path / "cache.json"))
+    tuning._cache.clear()
+    key = tuning._make_key(1, 256, 2, 16, True, jnp.bfloat16, True)
+    tuning._store_disk_cache("/".join(str(p) for p in key), (128, 128))
+
+    seen = []
+    real = attention._flash
+
+    def spy(q, k, v, causal, block_q, block_k, interpret):
+        seen.append((block_q, block_k))
+        return real(q, k, v, causal, block_q, block_k, interpret)
+
+    monkeypatch.setattr(attention, "_flash", spy)
+    q = jnp.ones((1, 256, 2, 16), jnp.bfloat16)
+    attention.flash_attention(q, q, q, causal=True)
+    assert seen == [(128, 128)]
+
+    # explicit block sizes always win over the table
+    seen.clear()
+    attention.flash_attention(q, q, q, causal=True, block_q=256, block_k=256)
+    assert seen == [(256, 256)]
