@@ -508,6 +508,53 @@ def bench_flash_attention(jax, on_tpu: bool):
     return result
 
 
+def bench_ring(jax, on_tpu: bool):
+    """Ring attention (shard_map + pallas per-block kernel) vs the plain
+    flash kernel at the same global shape. With one attached chip the
+    seq axis has a single shard, so the delta IS the ring machinery
+    overhead (shard_map partitioning + the degenerate rotation) — the
+    composition cost of one ring hop; multi-chip scaling then adds the
+    ppermute wire time that overlaps with block compute."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from flashy_tpu.ops import attention as attn_mod
+    from flashy_tpu.parallel.ring import ring_self_attention
+    from flashy_tpu.utils import device_sync
+
+    if not on_tpu:
+        return {"skipped": "composition overhead only meaningful on TPU"}
+    b, t, h, d = 2, 2048, 8, 64
+    reps = 10
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.bfloat16)
+               for _ in range(3))
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
+                ("data", "fsdp", "seq"))
+
+    def timed(fn):
+        grad = jax.jit(jax.grad(
+            lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2)))
+        device_sync(grad(q, k, v))
+        begin = time.perf_counter()
+        for _ in range(reps):
+            out = grad(q, k, v)
+        device_sync(out)
+        return (time.perf_counter() - begin) / reps
+
+    flash_t = timed(lambda q, k, v: attn_mod.flash_attention(
+        q, k, v, causal=True))
+    ring_t = timed(lambda q, k, v: ring_self_attention(
+        q, k, v, mesh=mesh, causal=True))
+    log(f"ring: {ring_t * 1e3:.2f}ms vs flash {flash_t * 1e3:.2f}ms "
+        f"(1-shard composition overhead {(ring_t / flash_t - 1) * 100:.0f}%)")
+    return {"ring_ms": round(ring_t * 1e3, 2),
+            "flash_ms": round(flash_t * 1e3, 2),
+            "overhead_pct": round((ring_t / flash_t - 1) * 100, 1),
+            "shape": [b, t, h, d]}
+
+
 def bench_gan(jax, on_tpu: bool):
     """The adversarial two-optimizer stage (BASELINE configs[3]): one
     generator step + one discriminator step per iteration, MLP G/D."""
@@ -625,7 +672,7 @@ def _persist_partial(extra: dict) -> None:
 # Leg execution order. smoke runs FIRST (on-chip kernel evidence within
 # the first minute of a tunnel window); mxu early so lm can report MFU
 # against the measured matmul ceiling.
-LEG_ORDER = ("smoke", "mxu", "cifar", "lm", "attention", "gan",
+LEG_ORDER = ("smoke", "mxu", "cifar", "lm", "attention", "ring", "gan",
              "host_sync", "all_reduce")
 
 
@@ -680,6 +727,7 @@ def child_main() -> None:
         "cifar": lambda: bench_cifar(jax, on_tpu),
         "lm": lambda: bench_lm(jax, on_tpu, peak, measured_flops()),
         "attention": lambda: bench_flash_attention(jax, on_tpu),
+        "ring": lambda: bench_ring(jax, on_tpu),
         "gan": lambda: bench_gan(jax, on_tpu),
         "host_sync": lambda: bench_host_sync(jax, on_tpu),
         "all_reduce": lambda: bench_all_reduce(jax),
