@@ -1,0 +1,106 @@
+# Tunnel-window orchestrator. The axon tunnel comes and goes (see
+# docs/TPU_NOTES.md); when a window opens it must be exploited fully
+# and automatically. This script polls for the backend, then runs the
+# full on-chip agenda as supervised subprocesses, each with its own
+# timeout, in priority order:
+#   1. tools/tpu_validate.py  — kernel parity + tuner table (evidence)
+#   2. bench.py               — the round's benchmark numbers
+#   3. tools/tpu_sweep.py     — throughput sweeps (tuning data)
+# Every stage persists its own results incrementally, so a mid-stage
+# tunnel collapse loses nothing; stages that already produced their
+# artifact are skipped on re-runs (pass --force to redo).
+"""Poll for the TPU tunnel; run validate → bench → sweeps when it opens."""
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+POLL_INTERVAL_S = float(os.environ.get("FLASHY_TPU_SESSION_POLL", "120"))
+POLL_BUDGET_S = float(os.environ.get("FLASHY_TPU_SESSION_WAIT", "10800"))
+
+STAGES = (
+    ("validate", [sys.executable, "tools/tpu_validate.py"], 1800,
+     "docs/TPU_VALIDATION.json"),
+    ("bench", [sys.executable, "bench.py"], 3300, "BENCH_PARTIAL.json"),
+    ("sweep", [sys.executable, "tools/tpu_sweep.py"], 2700,
+     "docs/TPU_SWEEPS.json"),
+)
+
+
+def log(msg: str) -> None:
+    print(f"[tpu-session] {time.strftime('%H:%M:%S')} {msg}",
+          file=sys.stderr, flush=True)
+
+
+def probe(timeout: float = 100.0) -> bool:
+    code = (
+        "import jax\n"
+        "from flashy_tpu.utils import pin_platform\n"
+        "pin_platform()\n"
+        "import jax.numpy as jnp, numpy as np\n"
+        "y = jax.jit(lambda x: x * 2)(jnp.ones((8, 128)))\n"
+        "assert float(np.asarray(y)[0, 0]) == 2.0\n"
+        "assert jax.default_backend() != 'cpu'\n"
+        "print('TPU_OK')\n"
+    )
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                              capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "TPU_OK" in proc.stdout
+
+
+def _fresh(path: str, started: float) -> bool:
+    """Artifact written after this session started?"""
+    try:
+        return os.path.getmtime(os.path.join(REPO, path)) >= started
+    except OSError:
+        return False
+
+
+def main() -> None:
+    force = "--force" in sys.argv
+    started = time.time()
+    deadline = time.monotonic() + POLL_BUDGET_S
+    attempt = 0
+    while True:
+        attempt += 1
+        if probe():
+            log(f"tunnel is UP (attempt {attempt})")
+            break
+        if time.monotonic() > deadline:
+            log("poll budget exhausted; tunnel never came up")
+            sys.exit(3)
+        log(f"tunnel down (attempt {attempt}); retrying in "
+            f"{POLL_INTERVAL_S:.0f}s")
+        time.sleep(POLL_INTERVAL_S)
+
+    failures = 0
+    for name, cmd, timeout, artifact in STAGES:
+        if not force and _fresh(artifact, started):
+            log(f"stage {name}: artifact already fresh, skipping")
+            continue
+        log(f"stage {name}: {' '.join(cmd)} (timeout {timeout}s)")
+        begin = time.monotonic()
+        try:
+            proc = subprocess.run(cmd, cwd=REPO, timeout=timeout,
+                                  stdout=sys.stderr, stderr=sys.stderr)
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            rc = "timeout"
+        log(f"stage {name}: rc={rc} after {time.monotonic() - begin:.0f}s")
+        if rc not in (0,):
+            failures += 1
+            # a wedged tunnel fails everything downstream too — probe
+            # cheaply before burning the next stage's timeout
+            if not probe():
+                log("tunnel gone; stopping the session")
+                sys.exit(4)
+    sys.exit(0 if failures == 0 else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,203 @@
+# On-chip throughput sweeps (run when a tunnel window opens): CIFAR
+# batch-size sweep and LM config sweep, all fetch-synced
+# (utils.device_sync — see docs/TPU_NOTES.md) with device-staged
+# batches so the ~20MB/s tunnel host link is not what gets measured.
+# Results append to docs/TPU_SWEEPS.json after every point, so a
+# mid-sweep tunnel collapse keeps everything measured so far.
+"""Sweep training configs on the live TPU; persist per-point results."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_PATH = os.path.join(REPO, "docs", "TPU_SWEEPS.json")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def log(msg: str) -> None:
+    print(f"[tpu-sweep] {msg}", file=sys.stderr, flush=True)
+
+
+def _persist(results: dict) -> None:
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    tmp = OUT_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=1, sort_keys=True)
+    os.replace(tmp, OUT_PATH)
+
+
+def sweep_cifar(jax, results: dict) -> None:
+    """img/s/chip across batch sizes (the headline metric's knob)."""
+    import jax.numpy as jnp
+    import optax
+    from flashy_tpu.models import resnet18
+    from flashy_tpu.parallel import make_mesh, wrap
+    from flashy_tpu.data import prefetch_to_device
+    from flashy_tpu.utils import device_sync
+
+    mesh = make_mesh({"data": len(jax.devices())})
+    model = resnet18(num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    optim = optax.sgd(0.1, momentum=0.9, nesterov=True)
+
+    def step(state, batch):
+        def loss_fn(params):
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": state["batch_stats"]},
+                batch["image"], train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["label"]).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"])
+        updates, opt_state = optim.update(grads, state["opt_state"])
+        return ({"params": optax.apply_updates(state["params"], updates),
+                 "batch_stats": batch_stats, "opt_state": opt_state},
+                {"loss": loss})
+
+    train_step = wrap(step, mesh=mesh, batch_axes=("data",))
+    table = results.setdefault("cifar_batch_sweep", {})
+    rng = np.random.default_rng(0)
+    for batch_size in (256, 512, 1024, 2048):
+        if str(batch_size) in table:
+            continue
+        state = {
+            "params": variables["params"],
+            "batch_stats": variables["batch_stats"],
+            "opt_state": optim.init(variables["params"]),
+        }
+        host = [{
+            "image": rng.normal(size=(batch_size, 32, 32, 3)).astype(np.float32),
+            "label": rng.integers(0, 10, batch_size).astype(np.int32),
+        } for _ in range(2)]
+        device_batches = list(prefetch_to_device(
+            iter(host), size=2, mesh=mesh, batch_axes=("data",)))
+        warmup, measure = 3, 15
+        for i in range(warmup):
+            state, metrics = train_step(state, device_batches[i % 2])
+        device_sync(metrics["loss"])
+        begin = time.perf_counter()
+        for i in range(measure):
+            state, metrics = train_step(state, device_batches[i % 2])
+        device_sync(metrics["loss"])
+        elapsed = time.perf_counter() - begin
+        img_s = measure * batch_size / elapsed / len(jax.devices())
+        table[str(batch_size)] = {
+            "images_per_sec_per_chip": round(img_s, 1),
+            "step_ms": round(elapsed / measure * 1e3, 1)}
+        log(f"cifar b={batch_size}: {img_s:.0f} img/s/chip")
+        _persist(results)
+
+
+def sweep_lm(jax, results: dict) -> None:
+    """tok/s/chip across LM variants: attention kind, batch, scan."""
+    import jax.numpy as jnp
+    import optax
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+    from flashy_tpu.utils import device_sync
+
+    table = results.setdefault("lm_sweep", {})
+    variants = [
+        ("flash_b16", dict(attention="flash", remat=True), 16),
+        ("flash_b32", dict(attention="flash", remat=True), 32),
+        ("flash_b8", dict(attention="flash", remat=True), 8),
+        ("flash_noremat_b8", dict(attention="flash", remat=False), 8),
+        ("flash_scan_b16", dict(attention="flash", remat=True,
+                                scan_layers=True), 16),
+    ]
+    seq, vocab, dim, layers, heads = 1024, 32768, 1024, 12, 16
+    rng = np.random.default_rng(0)
+    for name, overrides, batch in variants:
+        if name in table:
+            continue
+        cfg = TransformerConfig(vocab_size=vocab, dim=dim, num_layers=layers,
+                                num_heads=heads, **overrides)
+        model = TransformerLM(cfg)
+        params = {"params": model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 128), jnp.int32))["params"]}
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree_util.tree_leaves(params))
+        optim = optax.adamw(1e-4)
+        state = {"params": params, "opt_state": optim.init(params)}
+        tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+
+        def train_step(state, tokens, model=model, optim=optim):
+            def loss_fn(variables):
+                logits = model.apply(variables, tokens)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], tokens[:, 1:]).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            updates, opt_state = optim.update(grads, state["opt_state"],
+                                              state["params"])
+            return ({"params": optax.apply_updates(state["params"], updates),
+                     "opt_state": opt_state}, loss)
+
+        step = jax.jit(train_step, donate_argnums=(0,))
+        try:
+            compile_t0 = time.perf_counter()
+            state, loss = step(state, tokens)
+            device_sync(loss)
+            compile_s = time.perf_counter() - compile_t0
+            for _ in range(2):
+                state, loss = step(state, tokens)
+            device_sync(loss)
+            measure = 6
+            begin = time.perf_counter()
+            for _ in range(measure):
+                state, loss = step(state, tokens)
+            device_sync(loss)
+            elapsed = time.perf_counter() - begin
+        except Exception as exc:  # noqa: BLE001 — OOM etc: record + go on
+            table[name] = {"error": str(exc)[:200]}
+            log(f"lm {name}: FAILED {str(exc)[:100]}")
+            _persist(results)
+            continue
+        tok_s = measure * batch * seq / elapsed
+        step_ms = elapsed / measure * 1e3
+        flops_per_token = 6.0 * n_params + 6.0 * layers * seq * dim
+        table[name] = {
+            "tokens_per_sec_per_chip": round(tok_s / len(jax.devices()), 1),
+            "step_ms": round(step_ms, 1),
+            "achieved_tflops": round(flops_per_token * tok_s
+                                     / len(jax.devices()) / 1e12, 2),
+            "compile_s": round(compile_s, 1),
+            "batch": batch}
+        log(f"lm {name}: {tok_s:.0f} tok/s ({step_ms:.0f} ms/step, "
+            f"compile {compile_s:.0f}s)")
+        _persist(results)
+
+
+def main() -> None:
+    import jax
+    from flashy_tpu.utils import pin_platform
+    pin_platform()
+    platform = jax.default_backend()
+    if platform == "cpu" and not os.environ.get("FLASHY_TPU_SWEEP_ON_CPU"):
+        log("backend is CPU; sweeps are only meaningful on TPU — exiting")
+        sys.exit(2)
+    results = {}
+    if os.path.exists(OUT_PATH):
+        with open(OUT_PATH) as f:
+            results = json.load(f)
+    results["platform"] = platform
+    results["device_kind"] = jax.devices()[0].device_kind
+
+    for stage in (sweep_cifar, sweep_lm):
+        try:
+            stage(jax, results)
+        except Exception:  # noqa: BLE001
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+    _persist(results)
+    log("done")
+
+
+if __name__ == "__main__":
+    main()
