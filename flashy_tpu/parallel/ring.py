@@ -282,7 +282,8 @@ ring_attention.defvjp(_ring_fwd, _ring_bwd)
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         mesh: tp.Optional[Mesh] = None, axis: str = "seq",
                         causal: bool = False,
-                        batch_axes: tp.Sequence[str] = ("data", "fsdp")) -> jax.Array:
+                        batch_axes: tp.Sequence[str] = ("data", "fsdp"),
+                        check_vma: bool = False) -> jax.Array:
     """shard_map entry point: global [B, T, H, D] arrays, T sharded on `axis`.
 
     Shards the batch over `batch_axes` and the sequence over `axis`, runs
@@ -314,9 +315,11 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             tuple(use_batch_axes))
     spec = P(tuple(use_batch_axes) if use_batch_axes else None, axis, None, None)
     fn = functools.partial(ring_attention, axis_name=axis, causal=causal)
-    # check_vma=False: pallas interpret mode (the CPU test path) cannot
-    # yet propagate varying-axis types through its block slicing — the
-    # workaround the upstream error message prescribes. The vma checker
-    # is a tracer-level lint; numerics are unaffected.
+    # check_vma defaults to False: pallas interpret mode (the CPU test
+    # path) cannot yet propagate varying-axis types through its block
+    # slicing — the workaround the upstream error message prescribes.
+    # The vma checker is a tracer-level lint; numerics are unaffected.
+    # tools/tpu_validate.py probes check_vma=True on the real backend
+    # and records whether the strict check lowers there.
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+                         out_specs=spec, check_vma=check_vma)(q, k, v)

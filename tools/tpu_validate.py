@@ -150,6 +150,20 @@ def validate_ring(jax, results: dict) -> bool:
         ok &= passed
         log(f"ring/causal={causal}: grad_rel={rel:.2e} "
             f"{'OK' if passed else 'FAIL'}")
+
+    # Strict shard_map VMA checking is disabled by default because the
+    # CPU-interpret pallas path cannot propagate varying-axis types;
+    # probe whether the REAL backend's lowering passes the strict check
+    # (informational — a failure here does not fail validation).
+    try:
+        out = jax.jit(lambda q, k, v: ring_self_attention(
+            q, k, v, mesh=mesh, causal=True, check_vma=True))(q, k, v)
+        device_sync(out)
+        legs["check_vma_true_lowers"] = True
+    except Exception as exc:  # noqa: BLE001
+        legs["check_vma_true_lowers"] = False
+        legs["check_vma_error"] = str(exc)[:300]
+    log(f"ring/check_vma=True lowers: {legs['check_vma_true_lowers']}")
     results["ring_parity"] = legs
     return ok
 
