@@ -508,6 +508,49 @@ def bench_flash_attention(jax, on_tpu: bool):
     return result
 
 
+def bench_decode(jax, on_tpu: bool):
+    """KV-cache autoregressive generation throughput (tokens/s/chip) on
+    the flagship LM layout — the serving-side counterpart of the lm
+    training leg."""
+    import jax.numpy as jnp
+    import numpy as np
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+    from flashy_tpu.models.decoding import generate
+    from flashy_tpu.utils import device_sync
+
+    if on_tpu:
+        dim, layers, heads, vocab = 1024, 12, 16, 32768
+        batch, prompt_len, new_tokens = 8, 128, 128
+    else:
+        dim, layers, heads, vocab = 128, 2, 4, 512
+        batch, prompt_len, new_tokens = 2, 16, 16
+    cfg = TransformerConfig(vocab_size=vocab, dim=dim, num_layers=layers,
+                            num_heads=heads, attention="dense",
+                            max_seq_len=prompt_len + new_tokens)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    params = {"params": model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]}
+    prompt = jnp.asarray(rng.integers(0, vocab, (batch, prompt_len)),
+                         jnp.int32)
+
+    run = jax.jit(lambda params, prompt: generate(
+        model, params, prompt, max_new_tokens=new_tokens))
+    device_sync(run(params, prompt))  # compile
+    reps = 3
+    begin = time.perf_counter()
+    for _ in range(reps):
+        out = run(params, prompt)
+    device_sync(out)
+    elapsed = (time.perf_counter() - begin) / reps
+    tok_s = batch * new_tokens / elapsed / len(jax.devices())
+    log(f"decode: {tok_s:.0f} tok/s/chip (batch {batch}, "
+        f"{new_tokens} new tokens, {elapsed * 1e3:.0f}ms per call)")
+    return {"tokens_per_sec_per_chip": round(tok_s, 1),
+            "batch_size": batch, "new_tokens": new_tokens,
+            "ms_per_generate": round(elapsed * 1e3, 1)}
+
+
 def bench_ring(jax, on_tpu: bool):
     """Ring attention (shard_map + pallas per-block kernel) vs the plain
     flash kernel at the same global shape. With one attached chip the
@@ -673,7 +716,7 @@ def _persist_partial(extra: dict) -> None:
 # the first minute of a tunnel window); mxu early so lm can report MFU
 # against the measured matmul ceiling.
 LEG_ORDER = ("smoke", "mxu", "cifar", "lm", "attention", "ring", "gan",
-             "host_sync", "all_reduce")
+             "decode", "host_sync", "all_reduce")
 
 
 def _load_partial() -> dict:
@@ -728,6 +771,7 @@ def child_main() -> None:
         "lm": lambda: bench_lm(jax, on_tpu, peak, measured_flops()),
         "attention": lambda: bench_flash_attention(jax, on_tpu),
         "ring": lambda: bench_ring(jax, on_tpu),
+        "decode": lambda: bench_decode(jax, on_tpu),
         "gan": lambda: bench_gan(jax, on_tpu),
         "host_sync": lambda: bench_host_sync(jax, on_tpu),
         "all_reduce": lambda: bench_all_reduce(jax),
