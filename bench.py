@@ -304,6 +304,23 @@ def bench_cifar(jax, on_tpu: bool):
     from flashy_tpu.utils import device_sync
 
     batch_size = 512 if on_tpu else 64
+    if on_tpu:
+        # the sweep tool (tools/tpu_sweep.py) measures img/s across
+        # batch sizes; when its table exists, run the headline at the
+        # measured-best batch
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "docs", "TPU_SWEEPS.json")) as f:
+                sweep = json.load(f).get("cifar_batch_sweep", {})
+            best = max((v["images_per_sec_per_chip"], int(k))
+                       for k, v in sweep.items()
+                       if isinstance(v, dict)
+                       and "images_per_sec_per_chip" in v)
+            batch_size = best[1]
+            log(f"cifar: using swept-best batch size {batch_size} "
+                f"({best[0]:.0f} img/s in the sweep)")
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            pass
     warmup, measure = (5, 30) if on_tpu else (2, 5)
 
     devices = jax.devices()
