@@ -399,17 +399,34 @@ def bench_lm(jax, on_tpu: bool, peak_flops, measured_flops=None):
     # dense/no-remat variant needs 16.7G HBM at this size and OOMs the
     # 16G v5e (BENCH r3 first run); flash+remat is also simply the
     # TPU-idiomatic way to train this model.
+    overrides = {}
     if on_tpu:
         dim, layers, heads, vocab, seq, batch = 1024, 12, 16, 32768, 1024, 16
         warmup, measure = 3, 10
-        attention, remat = "flash", True
+        overrides = dict(attention="flash", remat=True)
+        # replay the winning variant from the sweep table when it exists
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "docs", "TPU_SWEEPS.json")) as f:
+                table = json.load(f).get("lm_sweep", {})
+            best = max((v["tokens_per_sec_per_chip"], name)
+                       for name, v in table.items()
+                       if isinstance(v, dict)
+                       and "tokens_per_sec_per_chip" in v)
+            entry = table[best[1]]
+            overrides = dict(entry.get("config_overrides") or overrides)
+            batch = entry.get("batch", batch)
+            log(f"lm: using swept-best variant '{best[1]}' "
+                f"({best[0]:.0f} tok/s in the sweep)")
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            pass
     else:
         dim, layers, heads, vocab, seq, batch = 128, 2, 4, 512, 128, 4
         warmup, measure = 1, 3
-        attention, remat = "dense", False
+        overrides = dict(attention="dense", remat=False)
 
     cfg = TransformerConfig(vocab_size=vocab, dim=dim, num_layers=layers,
-                            num_heads=heads, attention=attention, remat=remat)
+                            num_heads=heads, **overrides)
     model = TransformerLM(cfg)
     params = {"params": model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 128), jnp.int32))["params"]}

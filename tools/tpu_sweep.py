@@ -168,7 +168,9 @@ def sweep_lm(jax, results: dict) -> None:
             "achieved_tflops": round(flops_per_token * tok_s
                                      / len(jax.devices()) / 1e12, 2),
             "compile_s": round(compile_s, 1),
-            "batch": batch}
+            "batch": batch,
+            # bench_lm replays the winning variant from these
+            "config_overrides": dict(overrides)}
         log(f"lm {name}: {tok_s:.0f} tok/s ({step_ms:.0f} ms/step, "
             f"compile {compile_s:.0f}s)")
         _persist(results)
