@@ -82,11 +82,14 @@ def _causal_visible(qi, ki, block_q: int, block_k: int, offset: int):
 
 def _block_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k,
                   offset):
-    """Recompute the masked score block [block_q, block_k] on the MXU."""
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
+    """Recompute the masked score block [block_q, block_k] on the MXU.
+
+    Operands stay in their input dtype (bf16 normally) with f32
+    accumulation — the MXU's fast path; a pre-cast to f32 would force
+    multi-pass f32 matmuls at a fraction of the bf16 rate.
+    """
     scores = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     if causal:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -127,7 +130,6 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         scores = _block_scores(q_ref, k_ref, qi, ki, scale=scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k, offset=offset)
-        v = v_ref[0].astype(jnp.float32)          # [block_k, D]
 
         # All row statistics stay 2-D [block_q, 1] — the Mosaic-friendly
         # layout (no 1-D vector intermediates).
@@ -141,8 +143,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # the row would silently average V over masked keys.
         probs = _guarded_probs(scores, m_new)      # [block_q, block_k]
         l_new = l_scr[:, :1] * alpha + probs.sum(axis=-1, keepdims=True)
+        # P cast to V's dtype for the MXU fast path (FA2 practice);
+        # the row-sum normalizer above keeps full f32 precision.
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            probs, v, (((1,), (0,)), ((), ())),
+            probs.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         # Scratch rows are 128 lanes wide (the native f32 tile); the
         # scalar running stats live broadcast across the lane dim.
@@ -191,15 +195,16 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         # lse at the clamp floor; the forward emitted zeros for them and
         # the backward must emit zero grads, not exp(0)-weighted ones.
         probs = _guarded_probs(scores, lse)        # [block_q, block_k]
-        do = do_ref[0].astype(jnp.float32)         # [block_q, D]
-        v = v_ref[0].astype(jnp.float32)           # [block_k, D]
         dp = jax.lax.dot_general(                  # dO V^T [block_q, block_k]
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         delta = delta_ref[0, :, :1]                # [block_q, 1]
         ds = probs * (dp - delta) * scale
+        # dS cast to K's dtype: bf16 operands + f32 accumulation is the
+        # MXU fast path; dS itself is an exp-derived quantity with the
+        # same dynamic range as P.
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -236,18 +241,18 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, :, :1]
         # Same empty-row guard as _flash_dq_kernel.
         probs = _guarded_probs(scores, lse)        # [block_q, block_k]
-        do = do_ref[0].astype(jnp.float32)         # [block_q, D]
+        # P / dS cast to the operand dtype for bf16 MXU passes with f32
+        # accumulation (same rationale as the forward / dQ kernels).
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(   # P^T dO [block_k, D]
-            probs, do, (((0,), (0,)), ((), ())),
+            probs.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         delta = delta_ref[0, :, :1]
         ds = probs * (dp - delta) * scale          # [block_q, block_k]
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(   # dS^T Q [block_k, D]
-            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
