@@ -196,8 +196,12 @@ def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
             new_cache[name] = {"k": k_cache, "v": v_cache}
 
     x = _rmsnorm(x, p["norm_f"]["scale"], cfg.dtype)
-    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
-                        p["embed"].astype(jnp.float32))
+    # Head operands in the compute dtype + f32 accumulation — must
+    # match TransformerLM.__call__'s head exactly (the decode-vs-
+    # uncached-forward equality tests compare these logits).
+    logits = jnp.einsum("btd,vd->btv", x,
+                        p["embed"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
     return logits, new_cache
 
 

@@ -85,7 +85,9 @@ def pipelined_apply(model: TransformerLM, variables: tp.Mapping,
         x = result
 
     x = _rmsnorm(x, params["norm_f"]["scale"], cfg.dtype)
-    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), embedding,
+    # Same head scheme as TransformerLM.__call__ (pipe=1 loss-parity
+    # tests compare against it): compute-dtype operands, f32 accumulate.
+    logits = jnp.einsum("btd,vd->btv", x, embedding.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
     if moe:
         return logits, aux
