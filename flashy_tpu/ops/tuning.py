@@ -21,8 +21,8 @@ logger = logging.getLogger(__name__)
 # Candidate (block_q, block_k) tiles, all multiples of the 128-lane
 # vector width; the sweep keeps only those dividing the sequence length.
 CANDIDATES: tp.Tuple[tp.Tuple[int, int], ...] = (
-    (128, 128), (128, 256), (256, 128), (256, 256),
-    (256, 512), (512, 256), (512, 512),
+    (128, 128), (128, 256), (128, 512), (256, 128), (256, 256),
+    (256, 512), (256, 1024), (512, 256), (512, 512),
 )
 
 _cache: tp.Dict[tp.Tuple, tp.Tuple[int, int]] = {}
