@@ -83,8 +83,11 @@ def _block_forward(q, k, v, *, causal_diag: bool):
     m = scores.max(axis=-1)                          # [B, H, Tq]
     probs = _attn._guarded_probs(scores, m[..., None])
     denom = jnp.maximum(probs.sum(axis=-1), 1e-30)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs / denom[..., None],
-                     v.astype(jnp.float32))
+    # P in the operand dtype + f32 accumulation (the scheme the pallas
+    # kernels use); the block output stays f32 for the logaddexp merge.
+    out = jnp.einsum("bhqk,bkhd->bqhd",
+                     (probs / denom[..., None]).astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return out, m + jnp.log(denom)
 
 
@@ -122,12 +125,16 @@ def _block_backward(q, k, v, out_global, do, lse_rows, delta_rows, *,
     # forward lse hit the clamp floor have no visible key and must get
     # zero probs/gradients.
     probs = _attn._guarded_probs(scores, lse_rows[..., None])  # [B,H,Tq,Tk]
-    do_f = do.astype(jnp.float32)
-    dv = jnp.einsum("bhqk,bqhd->bkhd", probs, do_f)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", do_f, v.astype(jnp.float32))
+    # P/dS in the operand dtype + f32 accumulation, as in the kernels.
+    dv = jnp.einsum("bhqk,bqhd->bkhd", probs.astype(do.dtype), do,
+                    preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do, v,
+                    preferred_element_type=jnp.float32)
     ds = probs * (dp - delta_rows[..., None]) * scale
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32))
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds.astype(k.dtype), k,
+                    preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds.astype(q.dtype), q,
+                    preferred_element_type=jnp.float32)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
