@@ -748,9 +748,14 @@ def _persist_partial(extra: dict) -> None:
 
 # Leg execution order. smoke runs FIRST (on-chip kernel evidence within
 # the first minute of a tunnel window); mxu early so lm can report MFU
-# against the measured matmul ceiling.
-LEG_ORDER = ("smoke", "mxu", "cifar", "lm", "attention", "ring", "gan",
-             "decode", "host_sync", "all_reduce")
+# against the measured matmul ceiling. FLASHY_TPU_BENCH_LEGS (comma
+# list) restricts the run to a subset — handy for re-measuring one leg
+# and for the supervision tests.
+_LEGS_FILTER = os.environ.get("FLASHY_TPU_BENCH_LEGS")
+LEG_ORDER = tuple(
+    name for name in ("smoke", "mxu", "cifar", "lm", "attention", "ring",
+                      "gan", "decode", "host_sync", "all_reduce")
+    if _LEGS_FILTER is None or name in _LEGS_FILTER.split(","))
 
 
 def _load_partial() -> dict:
@@ -815,6 +820,8 @@ def child_main() -> None:
             continue
         extra["_current_leg"] = name
         _persist_partial(extra)
+        if name == os.environ.get("FLASHY_TPU_BENCH_FAKE_HANG"):
+            time.sleep(100000)  # fault injection for the supervision tests
         try:
             result = legs[name]()
         except Exception as exc:  # noqa: BLE001

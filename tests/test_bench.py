@@ -1,0 +1,43 @@
+# The bench's parent/child supervision is what stands between a wedged
+# TPU tunnel and an empty BENCH_r{N}.json (docs/TPU_NOTES.md); prove it
+# end-to-end with fault injection: a leg that hangs forever must be
+# killed, recorded as hung, and the remaining legs must still complete.
+"""Supervision test for bench.py (fault-injected hang)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_supervisor_kills_hung_leg_and_finishes(tmp_path):
+    # STALL must exceed the longest healthy leg (smoke on a loaded CPU
+    # runs ~60s and only leg COMPLETION refreshes the partial file);
+    # cifar/lm are excluded to keep the test under a few minutes.
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        FLASHY_TPU_BENCH_LEGS="smoke,mxu",
+        FLASHY_TPU_BENCH_FAKE_HANG="mxu",
+        FLASHY_TPU_BENCH_STALL="120",
+        FLASHY_TPU_BENCH_BUDGET="900",
+        FLASHY_TPU_BENCH_PROBE_TIMEOUT="90",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=840)
+    # no cifar leg -> no headline -> rc 1 by design; the point here is
+    # the supervision behavior, asserted from the payload
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    extra = payload["extra"]
+    # the hung leg was killed and blamed, not silently dropped
+    assert "hung" in extra["mxu"]["error"], extra["mxu"]
+    # the leg before it completed normally
+    assert "dense_ms" in extra["smoke"], extra["smoke"]
+    # no stray in-flight marker left behind
+    assert "_current_leg" not in extra
+    assert payload["value"] is None and proc.returncode == 1
