@@ -167,17 +167,28 @@ def _ring_forward_pass(q, k, v, axis_name: str, causal: bool):
     my_index = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
 
-    # Step 0: the device's own (diagonal) block.
+    # Step 0: the device's own (diagonal) block. The first rotation is
+    # issued BEFORE the block compute: the two are dataflow-independent
+    # (both only read the resident k/v), so XLA's latency-hiding
+    # scheduler can run the ppermute on ICI while the MXU works — the
+    # one-block-always-in-flight schedule of the ring construction.
+    if n_blocks > 1:
+        k_blk = jax.lax.ppermute(k, axis_name, perm)
+        v_blk = jax.lax.ppermute(v, axis_name, perm)
     out, lse = _block_forward(q, k, v, causal_diag=causal)
     out, lse = _mark_varying((out, lse), q)
 
     if n_blocks > 1:
         def step(carry, step_index):
+            # carry holds the block that already ARRIVED for this step
+            # (owner (my_index - s) mod n); the rotation for the NEXT
+            # step is issued here, independent of this step's compute,
+            # so the hop overlaps the block computation below. The last
+            # iteration's rotation is one wasted hop (it returns each
+            # block to its owner) — the price of the static schedule.
             out_acc, lse_acc, k_blk, v_blk = carry
-            # Rotate first: at step s the visiting block's owner is
-            # (my_index - s) mod n — same schedule as the backward.
-            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
             if causal:
                 # owner < my_index  <=>  my_index >= s: fully visible;
                 # otherwise the block is entirely in the future — skip
@@ -198,10 +209,10 @@ def _ring_forward_pass(q, k, v, axis_name: str, causal: bool):
                 out_b, lse_b = _block_forward(q, k_blk, v_blk,
                                               causal_diag=False)
                 out_acc, lse_acc = _merge(out_acc, lse_acc, out_b, lse_b)
-            return (out_acc, lse_acc, k_blk, v_blk), None
+            return (out_acc, lse_acc, k_nxt, v_nxt), None
 
         (out, lse, _, _), _ = jax.lax.scan(
-            step, (out, lse, k, v), jnp.arange(1, n_blocks))
+            step, (out, lse, k_blk, v_blk), jnp.arange(1, n_blocks))
     return out.astype(q.dtype), lse
 
 
@@ -215,6 +226,11 @@ def _ring_backward_pass(q, k, v, out, lse, do, axis_name: str, causal: bool):
     delta_rows = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                          axis=-1).transpose(0, 2, 1)   # [B, H, Tq]
 
+    # First K/V rotation issued before the own-block compute (both read
+    # only the resident k/v), so the hop overlaps the MXU work — same
+    # schedule as the forward.
+    if n_blocks > 1:
+        k_blk, v_blk = jax.lax.ppermute((k, v), axis_name, perm)
     dq, dk, dv = _block_backward(q, k, v, out, do, lse, delta_rows,
                                  causal_diag=causal)
     # Accumulate across ring steps in f32 (matching the forward merge);
@@ -224,10 +240,16 @@ def _ring_backward_pass(q, k, v, out, lse, do, axis_name: str, causal: bool):
 
     if n_blocks > 1:
         def step(carry, step_index):
-            dq_acc, k_blk, v_blk, dk_acc, dv_acc = carry
-            # dK/dV accumulators travel WITH their block.
-            k_blk, v_blk, dk_acc, dv_acc = jax.lax.ppermute(
-                (k_blk, v_blk, dk_acc, dv_acc), axis_name, perm)
+            # carry holds the block that already arrived for this step
+            # plus the dK/dV accumulators the device filled LAST step
+            # (they travel with their block, one rotation behind it).
+            # Both rotations below are independent of this step's block
+            # compute — dk_in/dv_in are only consumed at the final add —
+            # so the ICI hops overlap the MXU work.
+            dq_acc, k_blk, v_blk, dk_prev, dv_prev = carry
+            k_nxt, v_nxt = jax.lax.ppermute((k_blk, v_blk), axis_name, perm)
+            dk_in, dv_in = jax.lax.ppermute((dk_prev, dv_prev), axis_name,
+                                            perm)
 
             def visible(args):
                 dq_acc, dk_acc, dv_acc = args
@@ -241,15 +263,15 @@ def _ring_backward_pass(q, k, v, out, lse, do, axis_name: str, causal: bool):
             if causal:
                 dq_acc, dk_acc, dv_acc = jax.lax.cond(
                     my_index >= step_index, visible, lambda args: args,
-                    (dq_acc, dk_acc, dv_acc))
+                    (dq_acc, dk_in, dv_in))
             else:
-                dq_acc, dk_acc, dv_acc = visible((dq_acc, dk_acc, dv_acc))
-            return (dq_acc, k_blk, v_blk, dk_acc, dv_acc), None
+                dq_acc, dk_acc, dv_acc = visible((dq_acc, dk_in, dv_in))
+            return (dq_acc, k_nxt, v_nxt, dk_acc, dv_acc), None
 
         (dq, _, _, dk, dv), _ = jax.lax.scan(
-            step, (dq, k, v, dk, dv), jnp.arange(1, n_blocks))
-        # n-1 hops so far; one more returns each accumulator to the
-        # device that owns its K/V block.
+            step, (dq, k_blk, v_blk, dk, dv), jnp.arange(1, n_blocks))
+        # The in-scan rotations moved each accumulator n-1 hops; one more
+        # returns it to the device that owns its K/V block.
         dk, dv = jax.lax.ppermute((dk, dv), axis_name, perm)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 

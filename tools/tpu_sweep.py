@@ -64,9 +64,14 @@ def sweep_cifar(jax, results: dict) -> None:
     train_step = wrap(step, mesh=mesh, batch_axes=("data",))
     table = results.setdefault("cifar_batch_sweep", {})
     rng = np.random.default_rng(0)
+    # wrap() donates the state, so the first timed call deletes whatever
+    # buffers seeded it; keep a host-side snapshot and rebuild the state
+    # from it for every batch size.
+    variables_host = jax.tree_util.tree_map(np.asarray, variables)
     for batch_size in (256, 512, 1024, 2048):
         if str(batch_size) in table:
             continue
+        variables = jax.tree_util.tree_map(jnp.asarray, variables_host)
         state = {
             "params": variables["params"],
             "batch_stats": variables["batch_stats"],
@@ -176,6 +181,57 @@ def sweep_lm(jax, results: dict) -> None:
         _persist(results)
 
 
+def sweep_moe(jax, results: dict) -> None:
+    """Fwd+bwd time per MoE dispatch mode (ROADMAP: profile einsum vs
+    sorted vs dropless per mesh; single-chip run compares the kernel
+    paths without the EP exchange)."""
+    import jax.numpy as jnp
+    from flashy_tpu.models.moe import MoEMLP
+    from flashy_tpu.utils import device_sync
+
+    table = results.setdefault("moe_dispatch_sweep", {})
+    batch, seq, dim, hidden, experts = 8, 1024, 1024, 4096, 8
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, seq, dim)), jnp.bfloat16)
+    for mode in ("einsum", "sorted", "dropless"):
+        if mode in table:
+            continue
+        model = MoEMLP(dim=dim, hidden=hidden, num_experts=experts,
+                       top_k=2, dispatch=mode)
+        params = model.init(jax.random.PRNGKey(0), x[:1, :128])
+
+        def loss_fn(params, x, model=model):
+            out = model.apply(params, x)
+            return jnp.mean(jnp.square(out.astype(jnp.float32)))
+
+        step = jax.jit(jax.grad(loss_fn))
+        try:
+            compile_t0 = time.perf_counter()
+            g = step(params, x)
+            device_sync(g)
+            compile_s = time.perf_counter() - compile_t0
+            g = step(params, x)
+            device_sync(g)
+            measure = 8
+            begin = time.perf_counter()
+            for _ in range(measure):
+                g = step(params, x)
+            device_sync(g)
+            step_ms = (time.perf_counter() - begin) / measure * 1e3
+        except Exception as exc:  # noqa: BLE001 — lowering/OOM: record
+            table[mode] = {"error": str(exc)[:200]}
+            log(f"moe {mode}: FAILED {str(exc)[:100]}")
+            _persist(results)
+            continue
+        tok_s = batch * seq / (step_ms / 1e3)
+        table[mode] = {"step_ms": round(step_ms, 2),
+                       "tokens_per_sec": round(tok_s, 1),
+                       "compile_s": round(compile_s, 1),
+                       "shape": [batch, seq, dim, hidden, experts]}
+        log(f"moe {mode}: {step_ms:.1f} ms fwd+bwd ({tok_s:.0f} tok/s)")
+        _persist(results)
+
+
 def main() -> None:
     import jax
     from flashy_tpu.utils import pin_platform
@@ -191,7 +247,7 @@ def main() -> None:
     results["platform"] = platform
     results["device_kind"] = jax.devices()[0].device_kind
 
-    for stage in (sweep_cifar, sweep_lm):
+    for stage in (sweep_cifar, sweep_lm, sweep_moe):
         try:
             stage(jax, results)
         except Exception:  # noqa: BLE001
