@@ -38,7 +38,7 @@ class TransformerConfig:
     dropout: float = 0.0         # applied after attn-out and mlp-down when
                                  # train=True (pass rngs={'dropout': key})
     dtype: tp.Any = jnp.bfloat16
-    attention: str = "flash"     # 'flash' | 'dense' | 'ring'
+    attention: str = "flash"     # 'flash' | 'dense' | 'ring' | 'ring_fused'
     remat: bool = False          # jax.checkpoint each block (HBM for FLOPs)
     moe_experts: int = 0         # >0 replaces the MLP with a routed MoE
     moe_top_k: int = 1
@@ -91,9 +91,11 @@ class Attention(nn.Module):
         q = _rotary(q, positions)
         k = _rotary(k, positions)
 
-        if cfg.attention == "ring":
+        if cfg.attention in ("ring", "ring_fused"):
             from ..parallel import ring_self_attention
-            out = ring_self_attention(q, k, v, mesh=self.mesh, causal=True)
+            out = ring_self_attention(
+                q, k, v, mesh=self.mesh, causal=True,
+                impl="fused" if cfg.attention == "ring_fused" else "scan")
         elif cfg.attention == "flash":
             out = flash_attention(q, k, v, causal=True)
         else:

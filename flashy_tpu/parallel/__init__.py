@@ -8,5 +8,6 @@ from .mesh import make_mesh, default_mesh, set_default_mesh, mesh_shape_from_dev
 from .data_parallel import (wrap, shard_batch, replicate, fsdp_sharding,
                             shard_params, with_grad_accumulation)
 from .ring import ring_attention, ring_self_attention
+from .ring_fused import fused_ring_attention
 from .pipeline import pipeline
 from .moe_ep import ep_dropless_moe

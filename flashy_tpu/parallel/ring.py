@@ -312,13 +312,24 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         mesh: tp.Optional[Mesh] = None, axis: str = "seq",
                         causal: bool = False,
                         batch_axes: tp.Sequence[str] = ("data", "fsdp"),
-                        check_vma: bool = False) -> jax.Array:
+                        check_vma: bool = False,
+                        impl: str = "scan") -> jax.Array:
     """shard_map entry point: global [B, T, H, D] arrays, T sharded on `axis`.
 
     Shards the batch over `batch_axes` and the sequence over `axis`, runs
     `ring_attention` per device. Use inside a jitted step whose arrays
     already live on the mesh (the specs below just tell shard_map how to
     slice them).
+
+    `impl` selects the per-device construction:
+      * 'scan' (default) — lax.scan of pallas flash block kernels with
+        overlapped `ppermute` K/V rotation (`ring_attention`).
+      * 'fused' — the single-kernel forward of `ring_fused`: in-kernel
+        RDMA rotation overlapped with the flash compute. Requires
+        128-aligned local sequence blocks; NOTE: in interpret mode
+        (CPU testing) the mesh must leave at least one host device
+        outside the ring, or the simulated RDMA semaphore waits can
+        starve XLA's intra-op thread pool.
     """
     from .mesh import default_mesh
     mesh = mesh or default_mesh()
@@ -343,7 +354,16 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             "remaining axes.", q.shape[0], tuple(batch_axes), full_ways,
             tuple(use_batch_axes))
     spec = P(tuple(use_batch_axes) if use_batch_axes else None, axis, None, None)
-    fn = functools.partial(ring_attention, axis_name=axis, causal=causal)
+    if impl == "fused":
+        from .ring_fused import fused_ring_attention
+        mesh_axes = tuple((name, mesh.shape[name])
+                          for name in mesh.axis_names)
+        fn = functools.partial(fused_ring_attention, axis_name=axis,
+                               causal=causal, mesh_axes=mesh_axes)
+    elif impl == "scan":
+        fn = functools.partial(ring_attention, axis_name=axis, causal=causal)
+    else:
+        raise ValueError(f"impl must be 'scan' or 'fused', got {impl!r}")
     # check_vma defaults to False: pallas interpret mode (the CPU test
     # path) cannot yet propagate varying-axis types through its block
     # slicing — the workaround the upstream error message prescribes.
