@@ -425,6 +425,7 @@ def bench_lm(jax, on_tpu: bool, peak_flops, measured_flops=None):
         warmup, measure = 1, 3
         overrides = dict(attention="dense", remat=False)
 
+    loss_mode = overrides.pop("loss", "dense")
     cfg = TransformerConfig(vocab_size=vocab, dim=dim, num_layers=layers,
                             num_heads=heads, **overrides)
     model = TransformerLM(cfg)
@@ -439,9 +440,9 @@ def bench_lm(jax, on_tpu: bool, peak_flops, measured_flops=None):
 
     def train_step(state, tokens):
         def loss_fn(variables):
-            logits = model.apply(variables, tokens)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], tokens[:, 1:]).mean()
+            from flashy_tpu.ops import lm_next_token_loss
+            return lm_next_token_loss(model, variables, tokens,
+                                      mode=loss_mode)
 
         loss, grads = jax.value_and_grad(loss_fn)(state["params"])
         updates, opt_state = optim.update(grads, state["opt_state"],

@@ -55,6 +55,7 @@ class LMSolver(flashy_tpu.BaseSolver):
             num_layers=cfg.model.num_layers, num_heads=cfg.model.num_heads,
             mlp_ratio=cfg.model.mlp_ratio, attention=cfg.model.attention,
             remat=cfg.model.get("remat", False),
+            remat_policy=cfg.model.get("remat_policy", "full"),
             scan_layers=scan_layers,
             moe_experts=cfg.model.get("moe_experts", 0),
             moe_top_k=cfg.model.get("moe_top_k", 1),
@@ -111,6 +112,13 @@ class LMSolver(flashy_tpu.BaseSolver):
         pipe_micro = cfg.get("pipeline_microbatches", None)
         mesh = self.mesh
 
+        if (cfg.get("loss", "dense") == "chunked"
+                and (moe or pipe_stages > 1)):
+            raise ValueError(
+                "loss=chunked is not supported with MoE or pipeline "
+                "parallelism (those paths need logits + aux losses); "
+                "use loss=dense.")
+
         def loss_fn(variables, tokens):
             if pipe_stages > 1:
                 from flashy_tpu.models import pipelined_apply
@@ -123,6 +131,13 @@ class LMSolver(flashy_tpu.BaseSolver):
                 logits, mutated = model.apply(variables, tokens,
                                               mutable=["losses"])
                 aux = aux_weight * moe_aux_loss(mutated)
+            elif cfg.get("loss", "dense") == "chunked":
+                # Large-vocab HBM saver: never materialize [B, T, V]
+                # (ops.losses.chunked_softmax_cross_entropy).
+                from flashy_tpu.ops import lm_next_token_loss
+                return lm_next_token_loss(
+                    model, variables, tokens, mode="chunked",
+                    chunk_size=int(cfg.get("loss_chunk", 256)))
             else:
                 logits = model.apply(variables, tokens)
                 aux = 0.0

@@ -40,6 +40,14 @@ class TransformerConfig:
     dtype: tp.Any = jnp.bfloat16
     attention: str = "flash"     # 'flash' | 'dense' | 'ring' | 'ring_fused'
     remat: bool = False          # jax.checkpoint each block (HBM for FLOPs)
+    remat_policy: str = "full"   # what remat SAVES per block:
+                                 #   'full'  - nothing (recompute all);
+                                 #   'dots'  - matmul outputs saveable
+                                 #     (recompute only elementwise/norms
+                                 #     - most of the no-remat speed at a
+                                 #     fraction of the activation HBM);
+                                 #   'dots_no_batch' - contractions with
+                                 #     no batch dims (params-side only)
     moe_experts: int = 0         # >0 replaces the MLP with a routed MoE
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
@@ -147,6 +155,21 @@ class Block(nn.Module):
         return x
 
 
+def _remat(cfg: TransformerConfig):
+    """nn.remat wrapper for Block honouring cfg.remat_policy."""
+    policies = {
+        "full": None,
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if cfg.remat_policy not in policies:
+        raise ValueError(f"remat_policy must be one of {sorted(policies)}, "
+                         f"got {cfg.remat_policy!r}")
+    policy = policies[cfg.remat_policy]
+    kwargs = {"policy": policy} if policy is not None else {}
+    return nn.remat(Block, static_argnums=(3,), **kwargs)
+
+
 class _CarryBlock(nn.Module):
     """Block wrapper with scan-compatible (carry, out) signature.
 
@@ -160,7 +183,7 @@ class _CarryBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
-        block = nn.remat(Block, static_argnums=(3,)) if self.config.remat else Block
+        block = _remat(self.config) if self.config.remat else Block
         y = block(self.config, mesh=self.mesh, name="block")(
             x, positions, self.train)
         return y, None
@@ -175,7 +198,8 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array,
                  positions: tp.Optional[jax.Array] = None,
-                 train: bool = False) -> jax.Array:
+                 train: bool = False,
+                 return_hidden: bool = False) -> tp.Any:
         cfg = self.config
         if tokens.shape[1] > cfg.max_seq_len:
             raise ValueError(
@@ -201,11 +225,16 @@ class TransformerLM(nn.Module):
             x, _ = scan_block(cfg, mesh=self.mesh, train=train,
                               name="blocks")(x, positions)
         else:
-            block = nn.remat(Block, static_argnums=(3,)) if cfg.remat else Block
+            block = _remat(cfg) if cfg.remat else Block
             for layer in range(cfg.num_layers):
                 x = block(cfg, mesh=self.mesh, name=f"block_{layer}")(
                     x, positions, train)
         x = nn.RMSNorm(dtype=cfg.dtype, name="norm_f")(x)
+        if return_hidden:
+            # Skip the head: the caller contracts against the tied
+            # embedding itself (e.g. ops.losses.chunked_softmax_
+            # cross_entropy, which never materializes [B, T, V]).
+            return x, embedding
         # Tied output head: operands in the compute dtype (the model's
         # single largest matmul — f32 operands would run it at a
         # fraction of the bf16 MXU rate), accumulated in f32 for a

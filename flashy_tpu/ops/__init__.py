@@ -2,3 +2,4 @@
 # fallback elsewhere) and fused building blocks. flake8: noqa
 from .attention import dot_product_attention, flash_attention
 from .tuning import lookup_tuned_blocks, tune_flash_blocks
+from .losses import chunked_softmax_cross_entropy, lm_next_token_loss

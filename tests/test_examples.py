@@ -60,6 +60,19 @@ def test_lm_example(tmp_path):
     assert "generate" in history[0]
 
 
+def test_lm_example_chunked_loss(tmp_path):
+    # loss=chunked (ops.losses chunked CE head) through the example's
+    # own training path; same train/valid surface as the dense loss.
+    _run_example(tmp_path, "examples.lm.solver", "epochs=1",
+                 "steps_per_epoch=2", "batch_size=8", "seq_len=32",
+                 "model.dim=32", "model.num_layers=1", "model.num_heads=2",
+                 "model.vocab_size=64", "model.attention=dense",
+                 "loss=chunked", "loss_chunk=16")
+    history = _history(tmp_path)
+    assert "ppl" in history[0]["train"]
+    assert history[0]["train"]["loss"] > 0
+
+
 @pytest.mark.slow
 def test_lm_example_pipelined(tmp_path):
     # the flagship trains THROUGH the example's own pipe>1 code path

@@ -3,6 +3,7 @@
 # same loss and updates as the replicated single-device computation.
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -431,7 +432,50 @@ def test_moe_dropless_ep_matches_dropless():
     assert float(jnp.abs(g_router).max()) > 0
 
     # mesh is mandatory for this mode
-    import pytest
     with pytest.raises(ValueError):
         MoEMLP(dim=32, hidden=64, num_experts=4, dispatch="dropless_ep",
                dtype=jnp.float32).init(jax.random.PRNGKey(0), x)
+
+
+@pytest.mark.parametrize("policy", ["dots", "dots_no_batch"])
+def test_remat_policy_matches_full_remat(policy):
+    # Selective remat changes what is SAVED, never the math: loss and
+    # grads must match the full-remat config bit-for-bit (identical
+    # graph modulo recompute scheduling) at f32 tolerance.
+    import optax
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+
+    tokens = jnp.asarray(
+        np.random.default_rng(11).integers(0, 64, (2, 32)), jnp.int32)
+
+    def loss_and_grads(remat_policy):
+        cfg = TransformerConfig(vocab_size=64, dim=64, num_layers=2,
+                                num_heads=2, attention="dense", remat=True,
+                                remat_policy=remat_policy, dtype=jnp.float32)
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(0), tokens)
+
+        def loss_fn(params):
+            logits = model.apply(params, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+        return loss, grads
+
+    loss_full, grads_full = loss_and_grads("full")
+    loss_pol, grads_pol = loss_and_grads(policy)
+    np.testing.assert_allclose(float(loss_full), float(loss_pol), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-6),
+        grads_full, grads_pol)
+
+
+def test_remat_policy_unknown_raises():
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+    cfg = TransformerConfig(vocab_size=64, dim=64, num_layers=1, num_heads=2,
+                            remat=True, remat_policy="bogus")
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    with pytest.raises(ValueError, match="remat_policy"):
+        TransformerLM(cfg).init(jax.random.PRNGKey(0), tokens)

@@ -115,14 +115,29 @@ def sweep_lm(jax, results: dict) -> None:
         ("flash_noremat_b8", dict(attention="flash", remat=False), 8),
         ("flash_scan_b16", dict(attention="flash", remat=True,
                                 scan_layers=True), 16),
+        # Selective remat: save matmul outputs, recompute elementwise -
+        # the middle ground between full remat and no-remat OOM.
+        ("flash_rematdots_b16", dict(attention="flash", remat=True,
+                                     remat_policy="dots"), 16),
+        ("flash_rematdots_b32", dict(attention="flash", remat=True,
+                                     remat_policy="dots"), 32),
+        # Chunked CE frees the 2 GiB [B,T,V] f32 logits buffer - the
+        # no-remat configs that OOMed above b=8 may fit and win.
+        ("flash_noremat_chunked_b16", dict(attention="flash", remat=False,
+                                           loss="chunked"), 16),
+        ("flash_noremat_chunked_b32", dict(attention="flash", remat=False,
+                                           loss="chunked"), 32),
     ]
     seq, vocab, dim, layers, heads = 1024, 32768, 1024, 12, 16
     rng = np.random.default_rng(0)
     for name, overrides, batch in variants:
         if name in table:
             continue
+        overrides = dict(overrides)
+        loss_mode = overrides.pop("loss", "dense")
         cfg = TransformerConfig(vocab_size=vocab, dim=dim, num_layers=layers,
                                 num_heads=heads, **overrides)
+        overrides["loss"] = loss_mode  # recorded for bench replay
         model = TransformerLM(cfg)
         params = {"params": model.init(
             jax.random.PRNGKey(0), jnp.zeros((1, 128), jnp.int32))["params"]}
@@ -132,11 +147,12 @@ def sweep_lm(jax, results: dict) -> None:
         state = {"params": params, "opt_state": optim.init(params)}
         tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
 
-        def train_step(state, tokens, model=model, optim=optim):
+        def train_step(state, tokens, model=model, optim=optim,
+                       loss_mode=loss_mode):
             def loss_fn(variables):
-                logits = model.apply(variables, tokens)
-                return optax.softmax_cross_entropy_with_integer_labels(
-                    logits[:, :-1], tokens[:, 1:]).mean()
+                from flashy_tpu.ops import lm_next_token_loss
+                return lm_next_token_loss(model, variables, tokens,
+                                          mode=loss_mode)
 
             loss, grads = jax.value_and_grad(loss_fn)(state["params"])
             updates, opt_state = optim.update(grads, state["opt_state"],
