@@ -197,6 +197,106 @@ def sweep_lm(jax, results: dict) -> None:
         _persist(results)
 
 
+def sweep_attention_shapes(jax, results: dict) -> None:
+    """Flash fwd+bwd across head layouts at fixed model width.
+
+    Guides the head_dim=64 lane-utilization question (ROADMAP): d=64
+    fills half a 128-lane MXU tile, so (h=16, d=64) vs (h=8, d=128)
+    at equal H*D measures what the narrow head costs on this chip."""
+    import jax.numpy as jnp
+    from flashy_tpu.ops import flash_attention
+    from flashy_tpu.utils import device_sync
+
+    table = results.setdefault("attention_shape_sweep", {})
+    rng = np.random.default_rng(0)
+    b, t = 4, 2048
+    for heads, dim in ((16, 64), (8, 128), (32, 64), (16, 128)):
+        name = f"h{heads}_d{dim}"
+        if name in table:
+            continue
+        shape = (b, t, heads, dim)
+        q, k, v = (jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+                   for _ in range(3))
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True)
+                           .astype(jnp.float32) ** 2)
+
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        try:
+            g = step(q, k, v)
+            device_sync(g)
+            measure = 8
+            begin = time.perf_counter()
+            for _ in range(measure):
+                g = step(q, k, v)
+            device_sync(g)
+            ms = (time.perf_counter() - begin) / measure * 1e3
+        except Exception as exc:  # noqa: BLE001
+            table[name] = {"error": str(exc)[:200]}
+            log(f"attn {name}: FAILED {str(exc)[:100]}")
+            _persist(results)
+            continue
+        # causal fwd = two matmuls (QK^T, PV) = 4*b*h*t^2*d halved by
+        # causality; bwd ~2.5x fwd -> total 3.5 * fwd (the convention
+        # sweep_lm/bench_lm use for their attention term)
+        flops = 3.5 * 4 * b * heads * t * t * dim / 2
+        table[name] = {"ms": round(ms, 2),
+                       "achieved_tflops": round(flops / (ms / 1e3) / 1e12, 2),
+                       "shape": list(shape)}
+        log(f"attn {name}: {ms:.2f} ms fwd+bwd "
+            f"({table[name]['achieved_tflops']} TFLOP/s)")
+        _persist(results)
+
+
+def sweep_decode(jax, results: dict) -> None:
+    """KV-cache generation throughput across decode batch sizes."""
+    import jax.numpy as jnp
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+    from flashy_tpu.models.decoding import generate
+    from flashy_tpu.utils import device_sync
+
+    table = results.setdefault("decode_batch_sweep", {})
+    cfg = TransformerConfig(vocab_size=32768, dim=1024, num_layers=12,
+                            num_heads=16, attention="dense",
+                            max_seq_len=512)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))
+    rng = np.random.default_rng(0)
+    new_tokens = 128
+    # Whole-generate jit (the bench decode leg's pattern): per-token
+    # dispatch would measure the tunnel's 68 ms RTT, not the chip.
+    run = jax.jit(lambda params, prompt: generate(
+        model, params, prompt, max_new_tokens=new_tokens))
+    for batch in (1, 8, 32, 64):
+        name = str(batch)
+        if name in table:
+            continue
+        prompt = jnp.asarray(rng.integers(0, 32768, (batch, 32)), jnp.int32)
+        try:
+            device_sync(run(params, prompt))  # compile
+            # bench_decode's timing semantics (bench.py): dispatch all
+            # reps, sync once - a per-rep sync would add a tunnel RTT
+            # to every measurement.
+            reps = 3
+            begin = time.perf_counter()
+            outs = [run(params, prompt) for _ in range(reps)]
+            device_sync(outs[-1])
+            ms = (time.perf_counter() - begin) / reps * 1e3
+        except Exception as exc:  # noqa: BLE001
+            table[name] = {"error": str(exc)[:200]}
+            log(f"decode b={batch}: FAILED {str(exc)[:100]}")
+            _persist(results)
+            continue
+        tok_s = batch * new_tokens / (ms / 1e3) / len(jax.devices())
+        table[name] = {"ms_per_generate": round(ms, 1),
+                       "tokens_per_sec_per_chip": round(tok_s, 1),
+                       "new_tokens": new_tokens}
+        log(f"decode b={batch}: {tok_s:.0f} tok/s/chip ({ms:.0f} ms)")
+        _persist(results)
+
+
 def sweep_moe(jax, results: dict) -> None:
     """Fwd+bwd time per MoE dispatch mode (ROADMAP: profile einsum vs
     sorted vs dropless per mesh; single-chip run compares the kernel
@@ -263,7 +363,8 @@ def main() -> None:
     results["platform"] = platform
     results["device_kind"] = jax.devices()[0].device_kind
 
-    for stage in (sweep_cifar, sweep_lm, sweep_moe):
+    for stage in (sweep_cifar, sweep_lm, sweep_moe, sweep_attention_shapes,
+                  sweep_decode):
         try:
             stage(jax, results)
         except Exception:  # noqa: BLE001
