@@ -232,7 +232,7 @@ def bench_smoke(jax, on_tpu: bool):
     return out
 
 
-def bench_mxu(jax, peak_flops):
+def bench_mxu(jax, peak_flops, on_tpu=True):
     """Measured best-case bf16 matmul rate of the attached chip.
 
     The nominal peak (PEAK_FLOPS) assumes an unshared physical chip;
@@ -243,24 +243,38 @@ def bench_mxu(jax, peak_flops):
     import jax.numpy as jnp
     from flashy_tpu.utils import device_sync
 
-    n = 4096
+    # Best across several trials and sizes: a single short window on a
+    # time-sliced chip underestimates badly (r3: one 52 ms window read
+    # 45 TFLOP/s while the LM leg itself sustained 58.6 - the "ceiling"
+    # must be the best the chip ever delivers, so take the max).
     key = jax.random.PRNGKey(0)
-    a = (jax.random.normal(key, (n, n)) * (1.0 / n ** 0.5)).astype(jnp.bfloat16)
-    reps = 30
+    best = (0.0, 0, 0.0)   # (tflops, n, per_matmul)
+    # CPU fallback gets one small size (the number is diagnostic
+    # there, not a ceiling); any real accelerator gets the full sizes
+    # even if its device_kind has no PEAK_FLOPS entry.
+    for n in (4096, 8192) if on_tpu else (1024,):
+        a = (jax.random.normal(key, (n, n))
+             * (1.0 / n ** 0.5)).astype(jnp.bfloat16)
+        reps = 30 if n <= 4096 else 8
 
-    def chain(x):
-        # dependent chain inside ONE dispatch: no per-op tunnel latency
-        return jax.lax.fori_loop(0, reps, lambda i, y: a @ y, x)
+        def chain(x, a=a, reps=reps):
+            # dependent chain inside ONE dispatch: no per-op tunnel
+            # latency
+            return jax.lax.fori_loop(0, reps, lambda i, y: a @ y, x)
 
-    f = jax.jit(chain)
-    device_sync(f(a))
-    begin = time.perf_counter()
-    out = f(a)
-    device_sync(out)
-    per_matmul = (time.perf_counter() - begin) / reps
-    tflops = 2 * n ** 3 / per_matmul / 1e12
+        f = jax.jit(chain)
+        device_sync(f(a))
+        for _ in range(3):
+            begin = time.perf_counter()
+            out = f(a)
+            device_sync(out)
+            per_matmul = (time.perf_counter() - begin) / reps
+            tflops = 2 * n ** 3 / per_matmul / 1e12
+            if tflops > best[0]:
+                best = (tflops, n, per_matmul)
+    tflops, n, per_matmul = best
     log(f"mxu: {tflops:.1f} TFLOP/s measured bf16 matmul peak "
-        f"({per_matmul * 1e3:.2f} ms per {n}^3)")
+        f"({per_matmul * 1e3:.2f} ms per {n}^3, best of trials)")
     return {"measured_bf16_tflops": round(tflops, 2),
             "matmul_n": n,
             "pct_of_nominal_peak": (round(tflops * 1e12 / peak_flops * 100, 1)
@@ -806,7 +820,7 @@ def child_main() -> None:
 
     legs = {
         "smoke": lambda: bench_smoke(jax, on_tpu),
-        "mxu": lambda: bench_mxu(jax, peak),
+        "mxu": lambda: bench_mxu(jax, peak, on_tpu),
         "cifar": lambda: bench_cifar(jax, on_tpu),
         "lm": lambda: bench_lm(jax, on_tpu, peak, measured_flops()),
         "attention": lambda: bench_flash_attention(jax, on_tpu),

@@ -208,15 +208,18 @@ def sweep_attention_shapes(jax, results: dict) -> None:
     from flashy_tpu.utils import device_sync
 
     table = results.setdefault("attention_shape_sweep", {})
-    rng = np.random.default_rng(0)
     b, t = 4, 2048
     for heads, dim in ((16, 64), (8, 128), (32, 64), (16, 128)):
         name = f"h{heads}_d{dim}"
         if name in table:
             continue
         shape = (b, t, heads, dim)
-        q, k, v = (jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
-                   for _ in range(3))
+        # Operands generated ON DEVICE: shipping ~100 MB of host numpy
+        # through the ~20 MB/s tunnel link would burn seconds of the
+        # scarce window per config.
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.jit(lambda k: jax.random.normal(
+            k, shape, jnp.bfloat16))(key) for key in keys)
 
         def loss(q, k, v):
             return jnp.sum(flash_attention(q, k, v, causal=True)
