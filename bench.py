@@ -997,6 +997,55 @@ def main() -> None:
     extra = _supervise_legs(platform)
 
     headline = extra.get("cifar", {}).get("images_per_sec_per_chip")
+    # On-chip evidence must survive tunnel outages across runs: a TPU
+    # capture is archived (in docs/, committed like TPU_SWEEPS.json —
+    # the repo's convention for captured evidence), and a run without
+    # on-chip numbers embeds the most recent archive, clearly labeled
+    # last_good_tpu + captured_at, so the emitted JSON always carries
+    # the real-chip numbers (r1/r2 lost theirs this way). Decisions key
+    # on the LEGS' recorded platform, not the probe-time platform: a
+    # mid-run tunnel collapse flips the legs to CPU without updating
+    # main()'s variable.
+    archive = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "docs", "BENCH_TPU_LAST_GOOD.json")
+
+    def tpu_green_legs(record) -> int:
+        return sum(1 for name in LEG_ORDER
+                   if isinstance(record.get(name), dict)
+                   and "error" not in record[name]
+                   and record[name].get("leg_platform") == "tpu")
+
+    def load_archive():
+        try:
+            with open(archive) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    if headline and extra.get("cifar", {}).get("leg_platform") == "tpu":
+        prior = load_archive()
+        # A degraded run (headline ok, other legs hung) must not
+        # clobber a more complete capture.
+        if prior is None or tpu_green_legs(extra) >= tpu_green_legs(prior):
+            try:
+                record = dict(extra)
+                record["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+                tmp = archive + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(record, f, indent=1, sort_keys=True)
+                os.replace(tmp, archive)
+            except OSError as exc:
+                log(f"could not archive TPU results: {exc}")
+        else:
+            log("degraded TPU run (fewer green legs than the archive); "
+                "keeping the prior capture")
+    else:
+        prior = load_archive()
+        if prior is not None:
+            extra["last_good_tpu"] = prior
+            log("no on-chip headline this run: embedded the archived TPU "
+                f"capture ({prior.get('captured_at')})")
+
     payload = {
         "metric": "cifar10_resnet18_train_images_per_sec_per_chip",
         "value": headline,
