@@ -1022,29 +1022,26 @@ def main() -> None:
         except (OSError, ValueError):
             return None
 
-    if headline and extra.get("cifar", {}).get("leg_platform") == "tpu":
-        prior = load_archive()
-        # A degraded run (headline ok, other legs hung) must not
-        # clobber a more complete capture.
-        if prior is None or tpu_green_legs(extra) >= tpu_green_legs(prior):
-            try:
-                record = dict(extra)
-                record["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-                tmp = archive + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(record, f, indent=1, sort_keys=True)
-                os.replace(tmp, archive)
-            except OSError as exc:
-                log(f"could not archive TPU results: {exc}")
-        else:
-            log("degraded TPU run (fewer green legs than the archive); "
-                "keeping the prior capture")
-    else:
-        prior = load_archive()
-        if prior is not None:
-            extra["last_good_tpu"] = prior
-            log("no on-chip headline this run: embedded the archived TPU "
-                f"capture ({prior.get('captured_at')})")
+    prior = load_archive()
+    if (headline and extra.get("cifar", {}).get("leg_platform") == "tpu"
+            and (prior is None
+                 or tpu_green_legs(extra) >= tpu_green_legs(prior))):
+        try:
+            record = dict(extra)
+            record["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            tmp = archive + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(record, f, indent=1, sort_keys=True)
+            os.replace(tmp, archive)
+        except OSError as exc:
+            log(f"could not archive TPU results: {exc}")
+    elif prior is not None:
+        # Covers both the full CPU fallback AND a degraded TPU run
+        # whose legs flipped to CPU mid-way: whenever this run captured
+        # fewer green TPU legs than the archive, carry the archive.
+        extra["last_good_tpu"] = prior
+        log("run has fewer on-chip legs than the archive; embedded the "
+            f"prior TPU capture ({prior.get('captured_at')})")
 
     payload = {
         "metric": "cifar10_resnet18_train_images_per_sec_per_chip",

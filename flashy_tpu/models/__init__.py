@@ -7,4 +7,6 @@ from .moe import MoEMLP, moe_aux_loss
 from .resnet import ResNet, resnet18, resnet34, resnet50
 from .transformer import TransformerLM, TransformerConfig, transformer_shardings
 from .decoding import generate, init_cache
+from .quantize import (quantize_lm_params, dequantize_lm_params,
+                       is_quantized)
 from .pipelined import pipelined_apply

@@ -19,10 +19,32 @@ import jax
 import jax.numpy as jnp
 
 from .transformer import TransformerConfig, _rotary, rmsnorm as _rmsnorm
+from .quantize import is_quantized
 
 
 def _split_heads(qkv: jax.Array) -> tp.Tuple[jax.Array, jax.Array, jax.Array]:
     return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def _kernel(w, dtype):
+    """Matmul operand + output scale for a (possibly int8) kernel leaf.
+
+    Quantized leaves ({"q", "scale"}, models/quantize.py) contribute
+    the raw int8 payload converted to the compute dtype — a pure
+    elementwise convert XLA fuses into the dot's operand read — and the
+    per-output-channel scale to apply to the einsum RESULT. Dense
+    leaves scale by None.
+    """
+    if is_quantized(w):
+        return w["q"].astype(dtype), w["scale"]
+    return w.astype(dtype), None
+
+
+def _postscale(out: jax.Array, scale) -> jax.Array:
+    """Apply a kernel's output scale (broadcast over leading dims)."""
+    if scale is None:
+        return out
+    return out * scale.astype(out.dtype)
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> tp.Dict:
@@ -83,6 +105,13 @@ def _moe_forward(cfg: TransformerConfig, mp: tp.Dict, x: jax.Array) -> jax.Array
     w_up = mp["w_up"]                                  # [E, D, F]
     w_down = mp["w_down"]                              # [E, F, D]
 
+    def _take_expert(w, idx):
+        """Per-token expert slab + its output scale ([N, out] or None)."""
+        if is_quantized(w):
+            slab = jnp.take(w["q"], idx, axis=0).astype(cfg.dtype)
+            return slab, jnp.take(w["scale"], idx, axis=0)[:, 0, :]
+        return jnp.take(w, idx, axis=0).astype(cfg.dtype), None
+
     if n_tokens <= _MOE_GATHER_MAX_TOKENS:
         # Token-gather order: one [N, D, F] gather per used slot.
         out = jnp.zeros_like(x_flat, dtype=jnp.float32)
@@ -91,11 +120,13 @@ def _moe_forward(cfg: TransformerConfig, mp: tp.Dict, x: jax.Array) -> jax.Array
             expert_index = jnp.argmax(remaining, axis=-1)
             gate = jnp.take_along_axis(remaining, expert_index[:, None],
                                        axis=-1)[:, 0]
-            up = jnp.take(w_up, expert_index, axis=0).astype(cfg.dtype)
-            down = jnp.take(w_down, expert_index, axis=0).astype(cfg.dtype)
-            h = jax.nn.gelu(jnp.einsum("nd,ndf->nf",
-                                       x_flat.astype(cfg.dtype), up))
-            y = jnp.einsum("nf,nfd->nd", h, down)
+            up, up_s = _take_expert(w_up, expert_index)
+            down, down_s = _take_expert(w_down, expert_index)
+            # Scales apply to the einsum outputs, BEFORE the nonlinearity.
+            h = _postscale(jnp.einsum("nd,ndf->nf",
+                                      x_flat.astype(cfg.dtype), up), up_s)
+            y = _postscale(jnp.einsum("nf,nfd->nd", jax.nn.gelu(h), down),
+                           down_s)
             out = out + gate[:, None] * y.astype(jnp.float32)
             remaining = remaining * (1.0 - jax.nn.one_hot(
                 expert_index, num_experts))
@@ -103,13 +134,17 @@ def _moe_forward(cfg: TransformerConfig, mp: tp.Dict, x: jax.Array) -> jax.Array
         # Expert-stream order (prefill): every expert transforms the
         # full token set once; the combine gate (zero for unrouted
         # pairs) weights the sum. Identical result — f_e is linear in
-        # its weighting — without per-token weight copies.
+        # its weighting — without per-token weight copies. lax.scan
+        # slices quantized {"q","scale"} dicts leaf-wise, so each body
+        # sees one expert's int8 slab + [1, out] scale.
         x_c = x_flat.astype(cfg.dtype)
 
         def body(out, expert_in):
             up, down, gates = expert_in          # [D,F], [F,D], [N]
-            h = jax.nn.gelu(x_c @ up.astype(cfg.dtype))
-            y = h @ down.astype(cfg.dtype)
+            up_w, up_s = _kernel(up, cfg.dtype)
+            down_w, down_s = _kernel(down, cfg.dtype)
+            h = jax.nn.gelu(_postscale(x_c @ up_w, up_s))
+            y = _postscale(h @ down_w, down_s)
             return out + gates[:, None] * y.astype(jnp.float32), None
 
         out, _ = jax.lax.scan(
@@ -124,8 +159,8 @@ def _layer_forward(cfg: TransformerConfig, bp: tp.Dict, x: jax.Array,
                    v_cache: jax.Array, cache_index: jax.Array):
     """One block against cached K/V: returns (x, k_cache, v_cache)."""
     normed = _rmsnorm(x, bp["norm1"]["scale"], cfg.dtype)
-    qkv = jnp.einsum("btd,dchk->btchk", normed,
-                     bp["attn"]["qkv"]["kernel"].astype(cfg.dtype))
+    qkv_w, qkv_s = _kernel(bp["attn"]["qkv"]["kernel"], cfg.dtype)
+    qkv = _postscale(jnp.einsum("btd,dchk->btchk", normed, qkv_w), qkv_s)
     q, k, v = _split_heads(qkv)
     q = _rotary(q, positions)
     k = _rotary(k, positions)
@@ -145,19 +180,21 @@ def _layer_forward(cfg: TransformerConfig, bp: tp.Dict, x: jax.Array,
     scores = jnp.where(mask[:, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v_cache)
-    attn_out = jnp.einsum("bqhd,hdD->bqD", attn,
-                          bp["attn"]["out"]["kernel"].astype(cfg.dtype))
+    out_w, out_s = _kernel(bp["attn"]["out"]["kernel"], cfg.dtype)
+    attn_out = _postscale(jnp.einsum("bqhd,hdD->bqD", attn, out_w), out_s)
     x = x + attn_out
 
     normed = _rmsnorm(x, bp["norm2"]["scale"], cfg.dtype)
     if "moe" in bp:
         x = x + _moe_forward(cfg, bp["moe"], normed)
     else:
-        up = jnp.einsum("btd,df->btf", normed,
-                        bp["mlp"]["up"]["kernel"].astype(cfg.dtype))
+        up_w, up_s = _kernel(bp["mlp"]["up"]["kernel"], cfg.dtype)
+        up = _postscale(jnp.einsum("btd,df->btf", normed, up_w), up_s)
         gate, value = jnp.split(up, 2, axis=-1)
-        mlp_out = jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * value,
-                             bp["mlp"]["down"]["kernel"].astype(cfg.dtype))
+        down_w, down_s = _kernel(bp["mlp"]["down"]["kernel"], cfg.dtype)
+        mlp_out = _postscale(
+            jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * value, down_w),
+            down_s)
         x = x + mlp_out
     return x, k_cache, v_cache
 
@@ -173,7 +210,13 @@ def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
     stacked params + stacked cache.
     """
     p = params["params"]
-    x = jnp.take(p["embed"], tokens, axis=0).astype(cfg.dtype)
+    if is_quantized(p["embed"]):
+        # Row gather stays int8 (tiny); dequantize only the gathered rows.
+        x = (jnp.take(p["embed"]["q"], tokens, axis=0).astype(cfg.dtype)
+             * jnp.take(p["embed"]["scale"], tokens,
+                        axis=0).astype(cfg.dtype))
+    else:
+        x = jnp.take(p["embed"], tokens, axis=0).astype(cfg.dtype)
     if cfg.scan_layers:
         stacked = p["blocks"]["block"]  # every leaf has leading [L]
 
@@ -198,10 +241,17 @@ def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
     x = _rmsnorm(x, p["norm_f"]["scale"], cfg.dtype)
     # Head operands in the compute dtype + f32 accumulation — must
     # match TransformerLM.__call__'s head exactly (the decode-vs-
-    # uncached-forward equality tests compare these logits).
-    logits = jnp.einsum("btd,vd->btv", x,
-                        p["embed"].astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
+    # uncached-forward equality tests compare these logits). The
+    # quantized head's per-vocab-row scale applies to the f32 logits.
+    if is_quantized(p["embed"]):
+        logits = jnp.einsum("btd,vd->btv", x,
+                            p["embed"]["q"].astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        logits = logits * p["embed"]["scale"][:, 0]
+    else:
+        logits = jnp.einsum("btd,vd->btv", x,
+                            p["embed"].astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
     return logits, new_cache
 
 

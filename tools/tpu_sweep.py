@@ -272,31 +272,40 @@ def sweep_decode(jax, results: dict) -> None:
     # dispatch would measure the tunnel's 68 ms RTT, not the chip.
     run = jax.jit(lambda params, prompt: generate(
         model, params, prompt, max_new_tokens=new_tokens))
-    for batch in (1, 8, 32, 64):
-        name = str(batch)
+    from flashy_tpu.models import quantize_lm_params
+    qparams = None  # quantized lazily: resumed sweeps may skip all rows
+    variants = [(b, "") for b in (1, 8, 32, 64)]
+    # int8 weights-only decode: memory-bandwidth-bound small batches
+    # should approach 2x (models/quantize.py)
+    variants += [(b, "_int8") for b in (1, 8, 32)]
+    for batch, suffix in variants:
+        name = str(batch) + suffix
         if name in table:
             continue
+        if suffix and qparams is None:
+            qparams = quantize_lm_params(params)
+        run_params = qparams if suffix else params
         prompt = jnp.asarray(rng.integers(0, 32768, (batch, 32)), jnp.int32)
         try:
-            device_sync(run(params, prompt))  # compile
+            device_sync(run(run_params, prompt))  # compile
             # bench_decode's timing semantics (bench.py): dispatch all
             # reps, sync once - a per-rep sync would add a tunnel RTT
             # to every measurement.
             reps = 3
             begin = time.perf_counter()
-            outs = [run(params, prompt) for _ in range(reps)]
+            outs = [run(run_params, prompt) for _ in range(reps)]
             device_sync(outs[-1])
             ms = (time.perf_counter() - begin) / reps * 1e3
         except Exception as exc:  # noqa: BLE001
             table[name] = {"error": str(exc)[:200]}
-            log(f"decode b={batch}: FAILED {str(exc)[:100]}")
+            log(f"decode {name}: FAILED {str(exc)[:100]}")
             _persist(results)
             continue
         tok_s = batch * new_tokens / (ms / 1e3) / len(jax.devices())
         table[name] = {"ms_per_generate": round(ms, 1),
                        "tokens_per_sec_per_chip": round(tok_s, 1),
                        "new_tokens": new_tokens}
-        log(f"decode b={batch}: {tok_s:.0f} tok/s/chip ({ms:.0f} ms)")
+        log(f"decode {name}: {tok_s:.0f} tok/s/chip ({ms:.0f} ms)")
         _persist(results)
 
 
