@@ -1,5 +1,8 @@
 # Benchmark harness. Prints ONE JSON line on stdout:
 #   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+# The line is kept under MAX_LINE_CHARS (the driver records only a
+# ~2,000-char stdout tail): extra carries whitelisted per-leg scalars,
+# and the full record goes to BENCH_DETAIL.json next to this file.
 # All diagnostics go to stderr; the process exits 0 whenever a number was
 # produced (even on CPU fallback, flagged via extra.platform).
 #
@@ -60,6 +63,11 @@ LEGS_BUDGET_S = float(os.environ.get("FLASHY_TPU_BENCH_BUDGET", "2400"))
 # mid-run (driver timeout, tunnel collapse) still leaves its numbers.
 PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_PARTIAL.json")
+
+# Budget for the single stdout JSON line: the driver records only a
+# ~2,000-char tail of stdout, so the line must stay comfortably inside
+# it (r3's multi-KB line made BENCH_r03.json parse as null).
+MAX_LINE_CHARS = 1500
 
 # Peak bf16 matmul FLOP/s per chip, by device_kind substring (public
 # cloud.google.com/tpu/docs numbers).
@@ -402,43 +410,20 @@ def bench_cifar(jax, on_tpu: bool):
             "batch_size": batch_size}
 
 
-def bench_lm(jax, on_tpu: bool, peak_flops, measured_flops=None):
+def _measure_lm_config(jax, overrides, batch, seq, dims, warmup, measure,
+                       peak_flops, measured_flops):
+    """One LM training-throughput measurement at a given config.
+
+    Shared by the headline and the comparison sub-leg of bench_lm so the
+    two numbers come from identical timing discipline."""
     import jax.numpy as jnp
     import numpy as np
     import optax
     from flashy_tpu.models import TransformerConfig, TransformerLM
     from flashy_tpu.utils import device_sync
 
-    # TPU config: flash attention (pallas, O(T) memory) + remat — the
-    # dense/no-remat variant needs 16.7G HBM at this size and OOMs the
-    # 16G v5e (BENCH r3 first run); flash+remat is also simply the
-    # TPU-idiomatic way to train this model.
-    overrides = {}
-    if on_tpu:
-        dim, layers, heads, vocab, seq, batch = 1024, 12, 16, 32768, 1024, 16
-        warmup, measure = 3, 10
-        overrides = dict(attention="flash", remat=True)
-        # replay the winning variant from the sweep table when it exists
-        try:
-            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   "docs", "TPU_SWEEPS.json")) as f:
-                table = json.load(f).get("lm_sweep", {})
-            best = max((v["tokens_per_sec_per_chip"], name)
-                       for name, v in table.items()
-                       if isinstance(v, dict)
-                       and "tokens_per_sec_per_chip" in v)
-            entry = table[best[1]]
-            overrides = dict(entry.get("config_overrides") or overrides)
-            batch = entry.get("batch", batch)
-            log(f"lm: using swept-best variant '{best[1]}' "
-                f"({best[0]:.0f} tok/s in the sweep)")
-        except (OSError, ValueError, KeyError, json.JSONDecodeError):
-            pass
-    else:
-        dim, layers, heads, vocab, seq, batch = 128, 2, 4, 512, 128, 4
-        warmup, measure = 1, 3
-        overrides = dict(attention="dense", remat=False)
-
+    dim, layers, heads, vocab = dims
+    overrides = dict(overrides)
     loss_mode = overrides.pop("loss", "dense")
     cfg = TransformerConfig(vocab_size=vocab, dim=dim, num_layers=layers,
                             num_heads=heads, **overrides)
@@ -449,8 +434,7 @@ def bench_lm(jax, on_tpu: bool, peak_flops, measured_flops=None):
                    for x in jax.tree_util.tree_leaves(params))
 
     optim = optax.adamw(1e-4)
-    opt_state = optim.init(params)
-    state = {"params": params, "opt_state": opt_state}
+    state = {"params": params, "opt_state": optim.init(params)}
 
     def train_step(state, tokens):
         def loss_fn(variables):
@@ -489,9 +473,12 @@ def bench_lm(jax, on_tpu: bool, peak_flops, measured_flops=None):
     mfu = round(achieved / peak_flops, 4) if peak_flops else None
     # vs the chip's MEASURED matmul rate (bench_mxu): on a virtualized
     # tunnel slice the nominal peak is unattainable by construction.
+    # main() re-derives this against the capture-wide honest ceiling
+    # (max of every sustained rate in the run) before publishing.
     mfu_measured = (round(achieved / measured_flops, 4)
                     if measured_flops else None)
-    log(f"lm: {tokens_per_sec_per_chip:.0f} tok/s/chip, "
+    log(f"lm[{overrides.get('attention')},remat={overrides.get('remat')},"
+        f"b={batch}]: {tokens_per_sec_per_chip:.0f} tok/s/chip, "
         f"{achieved / 1e12:.1f} TFLOP/s/chip, MFU={mfu} "
         f"(vs measured peak: {mfu_measured}) "
         f"({n_params / 1e6:.0f}M params, seq {seq}, batch {batch})")
@@ -499,6 +486,70 @@ def bench_lm(jax, on_tpu: bool, peak_flops, measured_flops=None):
             "mfu": mfu, "mfu_vs_measured": mfu_measured,
             "achieved_tflops_per_chip": round(achieved / 1e12, 2),
             "n_params": n_params, "seq_len": seq, "batch_size": batch}
+
+
+# The r3 benched default: flash+remat at b=16 — kept as the published
+# comparison point for the promoted headline config (VERDICT r3 #1b).
+_LM_R3_DEFAULT = (dict(attention="flash", remat=True), 16)
+
+
+def bench_lm(jax, on_tpu: bool, peak_flops, measured_flops=None):
+    # TPU headline config: the best variant from the committed sweep
+    # table (docs/TPU_SWEEPS.json) when one exists — r3's sweep found
+    # flash/no-remat b=8 ~21% faster than the old flash+remat b=16
+    # default. Fallback: flash+remat, which never OOMs the 16G v5e
+    # (dense/no-remat at this size needs 16.7G HBM — BENCH r3 first
+    # run). The old default is re-measured as lm.comparison with the
+    # same timing discipline.
+    if on_tpu:
+        dims, seq = (1024, 12, 16, 32768), 1024
+        warmup, measure = 3, 10
+        overrides, batch = dict(_LM_R3_DEFAULT[0]), _LM_R3_DEFAULT[1]
+        variant = "default"
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "docs", "TPU_SWEEPS.json")) as f:
+                table = json.load(f).get("lm_sweep", {})
+            best = max((v["tokens_per_sec_per_chip"], name)
+                       for name, v in table.items()
+                       if isinstance(v, dict)
+                       and "tokens_per_sec_per_chip" in v)
+            entry = table[best[1]]
+            overrides = dict(entry.get("config_overrides") or overrides)
+            batch = entry.get("batch", batch)
+            variant = best[1]
+            log(f"lm: using swept-best variant '{best[1]}' "
+                f"({best[0]:.0f} tok/s in the sweep)")
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            pass
+    else:
+        dims, seq = (128, 2, 4, 512), 128
+        warmup, measure = 1, 3
+        overrides, batch = dict(attention="dense", remat=False), 4
+        variant = "cpu-tiny"
+
+    result = _measure_lm_config(jax, overrides, batch, seq, dims,
+                                warmup, measure, peak_flops, measured_flops)
+    result["variant"] = variant
+    if on_tpu and (overrides, batch) != _LM_R3_DEFAULT:
+        # The headline number exists NOW; the comparison below costs a
+        # second XLA compile, exactly where a tunnel wedge would get the
+        # whole leg SIGKILLed by the supervisor. Persist the headline as
+        # provisional first so a stall in the comparison cannot destroy
+        # it (the supervisor keeps provisional results on kill).
+        _persist_provisional("lm", result)
+        # comparison sub-leg: the r3 default, fewer reps (it only
+        # anchors the delta; the headline carries the record)
+        try:
+            comparison = _measure_lm_config(
+                jax, _LM_R3_DEFAULT[0], _LM_R3_DEFAULT[1], seq, dims,
+                2, 5, peak_flops, measured_flops)
+            comparison["variant"] = "r3-default(flash,remat,b=16)"
+            result["comparison"] = comparison
+        except Exception as exc:  # noqa: BLE001 — never lose the headline
+            log(f"lm comparison sub-leg failed: {exc}")
+            result["comparison"] = {"error": str(exc)[:200]}
+    return result
 
 
 def bench_flash_attention(jax, on_tpu: bool):
@@ -750,13 +801,136 @@ def bench_all_reduce(jax):
             "payload_mib": 64}
 
 
+def _capture_rates(record: dict, platform: str) -> list:
+    """Every sustained bf16 TFLOP/s rate this capture observed on
+    `platform`: the MXU microbench plus each LM leg's achieved rate."""
+    rates = []
+    mxu = record.get("mxu")
+    if (isinstance(mxu, dict) and mxu.get("leg_platform") == platform
+            and mxu.get("measured_bf16_tflops")):
+        rates.append(float(mxu["measured_bf16_tflops"]))
+    lm = record.get("lm")
+    if isinstance(lm, dict) and lm.get("leg_platform") == platform:
+        for leg in (lm, lm.get("comparison")):
+            if isinstance(leg, dict) and leg.get("achieved_tflops_per_chip"):
+                rates.append(float(leg["achieved_tflops_per_chip"]))
+    return rates
+
+
+def _apply_honest_ceiling(record: dict) -> None:
+    """Make mfu_vs_measured honest (VERDICT r3 weak #1).
+
+    A single short MXU window on a time-sliced chip can read BELOW what
+    the LM leg itself sustains (r3: mxu 45.3 vs lm 58.6 → published
+    ratio 1.29 — a 'ceiling' the chip demonstrably exceeds is not a
+    ceiling). Redefine it per capture: ceiling := max(every sustained
+    rate observed in the same record), stored as
+    mxu.ceiling_bf16_tflops, and re-derive every mfu_vs_measured from
+    it, so the published ratio is ≤ 1.0 by construction."""
+    lm = record.get("lm")
+    platform = (lm or {}).get("leg_platform") if isinstance(lm, dict) else None
+    if not platform:
+        return
+    mxu = record.get("mxu")
+    if not (isinstance(mxu, dict) and mxu.get("leg_platform") == platform
+            and mxu.get("measured_bf16_tflops")):
+        # No independent MXU measurement in this capture (mxu leg hung
+        # or errored): a ceiling built only from the lm legs' own rates
+        # would make mfu_vs_measured self-referentially 1.0. Publish no
+        # ratio at all instead.
+        for leg in (lm, lm.get("comparison")):
+            if isinstance(leg, dict):
+                leg["mfu_vs_measured"] = None
+        return
+    ceiling = max(_capture_rates(record, platform))
+    mxu["ceiling_bf16_tflops"] = round(ceiling, 2)
+    for leg in (lm, lm.get("comparison")):
+        if isinstance(leg, dict) and leg.get("achieved_tflops_per_chip"):
+            leg["mfu_vs_measured"] = round(
+                float(leg["achieved_tflops_per_chip"]) / ceiling, 4)
+
+
+# Per-leg scalar whitelist for the one-line stdout payload. Everything
+# else (shapes, params counts, per-trial detail, the full last-good
+# archive) goes to BENCH_DETAIL.json: r3's line grew past the driver's
+# 2,000-char tail and parsed as null (VERDICT r3 missing #1).
+_COMPACT_KEYS = {
+    "smoke": ("flash_speedup", "lm_step_ms"),
+    "mxu": ("measured_bf16_tflops", "ceiling_bf16_tflops"),
+    "cifar": ("images_per_sec_per_chip", "batch_size"),
+    "lm": ("tokens_per_sec_per_chip", "mfu", "mfu_vs_measured",
+           "achieved_tflops_per_chip", "variant"),
+    "attention": ("speedup", "flash_tuned_ms"),
+    "ring": ("overhead_pct",),
+    "gan": ("steps_per_sec",),
+    "decode": ("tokens_per_sec_per_chip",),
+    "host_sync": ("gib_per_sec",),
+    "all_reduce": ("bus_bandwidth_gb_s",),
+}
+
+
+def _compact_legs(record: dict, platform: str,
+                  headline_only: bool = False) -> dict:
+    """Whitelisted scalars per leg; errors truncated; skipped legs and
+    legs whose platform matches the top level carry no platform tag.
+    headline_only (the last-good archive embed) keeps just the lead
+    scalar per leg (two for lm), only for the evidence-bearing legs,
+    and drops errored legs."""
+    out = {}
+    for name in LEG_ORDER:
+        if headline_only and name not in ("mxu", "cifar", "lm",
+                                          "attention", "decode"):
+            continue
+        leg = record.get(name)
+        if not isinstance(leg, dict) or "skipped" in leg:
+            continue
+        if "error" in leg:
+            if headline_only:
+                continue
+            out[name] = {"error": str(leg["error"])[:60]}
+        else:
+            keys = _COMPACT_KEYS.get(name, ())
+            if headline_only:
+                keys = keys[:2 if name == "lm" else 1]
+            out[name] = {k: leg[k] for k in keys if leg.get(k) is not None}
+            comp = leg.get("comparison")
+            if name == "lm" and not headline_only and isinstance(comp, dict) \
+                    and comp.get("tokens_per_sec_per_chip") is not None:
+                out[name]["comparison_tok_s"] = comp["tokens_per_sec_per_chip"]
+        lp = leg.get("leg_platform")
+        if lp and lp != platform:
+            out[name]["platform"] = lp
+    return out
+
+
+def _atomic_json_write(path: str, obj: dict) -> None:
+    """json.dump to a sibling tmp file, then atomic rename."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def _persist_provisional(name: str, result: dict) -> None:
+    """Record a leg's headline measurement before its optional tail.
+
+    A leg that keeps measuring after its headline number exists (lm's
+    comparison sub-leg) writes the headline to the partial file first,
+    flagged provisional; the supervisor preserves (instead of
+    overwriting) a provisional result when it has to kill the child
+    mid-tail."""
+    extra = _load_partial()
+    entry = dict(result)
+    entry["leg_platform"] = os.environ.get("FLASHY_TPU_BENCH_PLATFORM", "cpu")
+    entry["provisional"] = True
+    extra[name] = entry
+    _persist_partial(extra)
+
+
 def _persist_partial(extra: dict) -> None:
     """Refresh BENCH_PARTIAL.json after every leg (atomic rename)."""
     try:
-        tmp = PARTIAL_PATH + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(extra, f, indent=1, sort_keys=True)
-        os.replace(tmp, PARTIAL_PATH)
+        _atomic_json_write(PARTIAL_PATH, extra)
     except OSError as exc:  # never let persistence kill the bench
         log(f"could not persist partial results: {exc}")
 
@@ -837,6 +1011,11 @@ def child_main() -> None:
         _persist_partial(extra)
         if name == os.environ.get("FLASHY_TPU_BENCH_FAKE_HANG"):
             time.sleep(100000)  # fault injection for the supervision tests
+        if name == os.environ.get("FLASHY_TPU_BENCH_FAKE_HANG_TAIL"):
+            # fault injection: headline persisted, then the leg's tail
+            # (e.g. lm's comparison sub-leg) wedges
+            _persist_provisional(name, {"tokens_per_sec_per_chip": 1.0})
+            time.sleep(100000)
         try:
             result = legs[name]()
         except Exception as exc:  # noqa: BLE001
@@ -927,7 +1106,13 @@ def _supervise_legs(platform: str) -> dict:
             else:
                 message = f"leg crashed (child rc={child.returncode})"
             log(f"leg '{in_flight}': {message}")
-            extra[in_flight] = {"error": message, "leg_platform": platform}
+            existing = extra.get(in_flight)
+            if isinstance(existing, dict) and existing.pop("provisional", None):
+                # the leg's headline was already persisted; only its
+                # optional tail (lm's comparison sub-leg) was lost
+                existing["incomplete"] = message
+            else:
+                extra[in_flight] = {"error": message, "leg_platform": platform}
             skip.add(in_flight)
         _persist_partial(extra)
         done_after = sum(isinstance(extra.get(n), dict) for n in LEG_ORDER)
@@ -995,6 +1180,7 @@ def main() -> None:
     _persist_partial(extra)
 
     extra = _supervise_legs(platform)
+    _apply_honest_ceiling(extra)
 
     headline = extra.get("cifar", {}).get("images_per_sec_per_chip")
     # On-chip evidence must survive tunnel outages across runs: a TPU
@@ -1023,16 +1209,17 @@ def main() -> None:
             return None
 
     prior = load_archive()
+    if prior is not None:
+        # retro-fit the honest ceiling to archives captured before it
+        # existed (the committed r3 archive publishes ratio 1.29)
+        _apply_honest_ceiling(prior)
     if (headline and extra.get("cifar", {}).get("leg_platform") == "tpu"
             and (prior is None
                  or tpu_green_legs(extra) >= tpu_green_legs(prior))):
         try:
             record = dict(extra)
             record["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-            tmp = archive + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(record, f, indent=1, sort_keys=True)
-            os.replace(tmp, archive)
+            _atomic_json_write(archive, record)
         except OSError as exc:
             log(f"could not archive TPU results: {exc}")
     elif prior is not None:
@@ -1043,15 +1230,47 @@ def main() -> None:
         log("run has fewer on-chip legs than the archive; embedded the "
             f"prior TPU capture ({prior.get('captured_at')})")
 
+    # Full record (every field, the embedded archive, sub-legs) goes to
+    # a file; the stdout line carries headline + per-leg scalars only.
+    # The driver keeps a ~2,000-char tail of stdout — r3's line outgrew
+    # it and the round's official record parsed as null.
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAIL.json")
+    try:
+        _atomic_json_write(detail_path, extra)
+    except OSError as exc:
+        log(f"could not write {detail_path}: {exc}")
+
+    compact = {k: extra[k] for k in
+               ("platform", "device_kind", "n_devices", "probe_attempts",
+                "peak_bf16_tflops", "legs_cpu_fallback") if k in extra}
+    if extra.get("backend_error"):
+        compact["backend_error"] = str(extra["backend_error"])[:80]
+    compact["legs"] = _compact_legs(extra, compact.get("platform"))
+    if isinstance(extra.get("last_good_tpu"), dict):
+        compact["last_good_tpu"] = {
+            "captured_at": extra["last_good_tpu"].get("captured_at"),
+            "legs": _compact_legs(extra["last_good_tpu"], "tpu",
+                                  headline_only=True),
+        }
+    compact["detail_path"] = "BENCH_DETAIL.json"
+
     payload = {
         "metric": "cifar10_resnet18_train_images_per_sec_per_chip",
         "value": headline,
         "unit": "images/sec/chip",
         "vs_baseline": (round(headline / REFERENCE_IMAGES_PER_SEC, 3)
                         if headline else None),
-        "extra": extra,
+        "extra": compact,
     }
-    print(json.dumps(payload), flush=True)
+    line = json.dumps(payload, separators=(",", ":"))
+    if len(line) > MAX_LINE_CHARS:  # hard guard: shed detail, keep headline
+        for key in ("last_good_tpu", "legs", "backend_error"):
+            compact.pop(key, None)
+            line = json.dumps(payload, separators=(",", ":"))
+            if len(line) <= MAX_LINE_CHARS:
+                break
+    print(line, flush=True)
     # rc=0 whenever the headline number exists (even on CPU fallback);
     # rc=1 only when the bench itself could not produce it.
     sys.exit(0 if headline is not None else 1)

@@ -135,6 +135,22 @@ def is_distributed() -> bool:
     return world_size() > 1
 
 
+def _require_backend() -> None:
+    """Fail loud when a collective runs before `init()`.
+
+    The launcher env can say world_size > 1 (so `is_distributed()` is
+    True) while `jax.distributed` was never initialized — the user's
+    entry point forgot `distrib.init()`. multihost_utils collectives
+    then see a 1-process world and return garbage (broadcast_object
+    used to die with an opaque pickle EOFError three frames later)."""
+    if not (_initialized or jax.distributed.is_initialized()):
+        raise RuntimeError(
+            f"This run is distributed (world_size={world_size()} from the "
+            "launcher environment) but flashy_tpu.distrib.init() was never "
+            "called. Call distrib.init() at the start of your entry point, "
+            "before any collective (see examples/cifar/train.py).")
+
+
 def rank_zero_only(fn: tp.Callable) -> tp.Callable:
     """Decorator: run only on process 0 (logging, checkpoint IO, media).
 
@@ -162,6 +178,7 @@ def _check_tree_sizes(tree: tp.Any) -> None:
     """
     if not is_distributed():
         return
+    _require_backend()
     from jax.experimental import multihost_utils
     leaves = jax.tree_util.tree_leaves(tree)
     signature = np.array([len(leaves), sum(int(np.size(leaf)) for leaf in leaves)],
@@ -189,6 +206,7 @@ def all_reduce(value: tp.Any, op: str = "sum") -> tp.Any:
     """
     if not is_distributed():
         return value
+    _require_backend()
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(np.asarray(value))
     if op == "sum":
@@ -348,6 +366,7 @@ def broadcast_tensors(tree: tp.Any, src: int = 0) -> tp.Any:
     """
     if not is_distributed():
         return tree
+    _require_backend()
     from jax.experimental import multihost_utils
     floats, treedef = _partition_floats(tree)
     _check_tree_sizes(floats)
@@ -435,6 +454,7 @@ def broadcast_object(obj: tp.Any = None, src: int = 0) -> tp.Any:
     """
     if not is_distributed():
         return obj
+    _require_backend()
     import pickle
     from jax.experimental import multihost_utils
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8) if rank() == src \
@@ -450,6 +470,7 @@ def broadcast_object(obj: tp.Any = None, src: int = 0) -> tp.Any:
 def barrier(name: str = "flashy_tpu_barrier") -> None:
     """Block until every process reaches this point."""
     if is_distributed():
+        _require_backend()
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)
 

@@ -86,6 +86,7 @@ def test_host_sync_warning_after_repeated_big_trees(monkeypatch, caplog):
     # simulate distribution so average_tensors takes the sync path while
     # stubbing the actual collective (single process here)
     monkeypatch.setattr(distrib, "is_distributed", lambda: True)
+    monkeypatch.setattr(distrib, "_require_backend", lambda: None)
     monkeypatch.setattr(distrib, "_reduce_mean_across_processes",
                         lambda floats: floats)
     monkeypatch.setattr(distrib, "_host_sync_big_calls", 0)
@@ -109,3 +110,21 @@ def test_host_sync_warning_after_repeated_big_trees(monkeypatch, caplog):
             distrib.average_tensors({"loss": np.zeros(3, np.float32)},
                                     method="reduce")
     assert not caplog.records
+
+
+def test_collectives_require_init(monkeypatch):
+    """Launcher env says distributed but init() was never called: every
+    collective must raise the clear RuntimeError, not misbehave (the
+    old failure was an opaque pickle EOFError out of broadcast_object)."""
+    monkeypatch.setenv("FLASHY_TPU_COORDINATOR", "localhost:1")
+    monkeypatch.setenv("FLASHY_TPU_NUM_PROCESSES", "2")
+    monkeypatch.setenv("FLASHY_TPU_PROCESS_ID", "1")
+    assert distrib.is_distributed()
+    for call in (lambda: distrib.broadcast_object({"kind": 1}),
+                 lambda: distrib.barrier(),
+                 lambda: distrib.all_reduce(np.ones(2)),
+                 lambda: distrib.average_metrics({"loss": 1.0}),
+                 lambda: distrib.broadcast_tensors({"w": np.ones(2)}),
+                 lambda: distrib._check_tree_sizes({"w": np.ones(2)})):
+        with pytest.raises(RuntimeError, match="distrib.init"):
+            call()
