@@ -59,10 +59,23 @@ PROBE_PAUSE_S = 15.0
 STALL_S = float(os.environ.get("FLASHY_TPU_BENCH_STALL", "480"))
 LEGS_BUDGET_S = float(os.environ.get("FLASHY_TPU_BENCH_BUDGET", "2400"))
 
+# After a CPU fallback the supervisor keeps re-probing between children
+# at this cadence: a tunnel that comes up at minute 20 still promotes
+# the remaining (and re-runs the fallen-back) legs to the chip.
+REPROBE_INTERVAL_S = float(os.environ.get("FLASHY_TPU_BENCH_REPROBE", "240"))
+# ...and once every leg has finished as CPU fallback, it keeps probing
+# for this much longer before settling for the CPU record (bounded so a
+# dead tunnel can't stall the bench past the driver's patience).
+CPU_RECOVERY_WAIT_S = float(os.environ.get("FLASHY_TPU_BENCH_CPU_WAIT", "600"))
+
 # Partial results land here as each leg completes, so a bench killed
 # mid-run (driver timeout, tunnel collapse) still leaves its numbers.
-PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_PARTIAL.json")
+# The state dir is overridable so concurrent runs (pytest-xdist, a test
+# alongside a real bench) don't race on the same files.
+_STATE_DIR = os.environ.get("FLASHY_TPU_BENCH_STATE_DIR",
+                            os.path.dirname(os.path.abspath(__file__)))
+PARTIAL_PATH = os.path.join(_STATE_DIR, "BENCH_PARTIAL.json")
+DETAIL_PATH = os.path.join(_STATE_DIR, "BENCH_DETAIL.json")
 
 # Budget for the single stdout JSON line: the driver records only a
 # ~2,000-char tail of stdout, so the line must stay comfortably inside
@@ -85,13 +98,34 @@ def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _peak_for(device_kind: str):
+    """Nominal peak bf16 FLOP/s for a device kind, or None if unknown."""
+    kind_lower = (device_kind or "").lower()
+    for needle, flops in PEAK_FLOPS:
+        if needle in kind_lower:
+            return flops
+    return None
+
+
 def probe_backend(timeout: float):
     """Initialize the accelerator backend in a child process.
 
     Returns (info_dict, None) on success or (None, error_string) on
     failure — including the hang case, which a raw `jax.devices()` in
     this process could never recover from.
+
+    Fault injection: FLASHY_TPU_BENCH_FAKE_PROBE names a JSON file that
+    stands in for the real probe — absent file = backend down, its
+    contents = the probe info. Lets the supervision tests exercise
+    probe failure and MID-RUN recovery without a real tunnel.
     """
+    fake = os.environ.get("FLASHY_TPU_BENCH_FAKE_PROBE")
+    if fake:
+        try:
+            with open(fake) as f:
+                return json.load(f), None
+        except (OSError, ValueError) as exc:
+            return None, f"fake probe: {exc}"
     code = (
         "import json, sys\n"
         "import jax\n"
@@ -843,11 +877,20 @@ def _apply_honest_ceiling(record: dict) -> None:
                 leg["mfu_vs_measured"] = None
         return
     ceiling = max(_capture_rates(record, platform))
+    mxu_rate = float(mxu["measured_bf16_tflops"])
     mxu["ceiling_bf16_tflops"] = round(ceiling, 2)
+    # When an LM leg itself sets the ceiling, its ratio would be a
+    # self-referential 1.0 — not an independent measurement. Record
+    # where the ceiling came from, and publish no ratio for the leg
+    # that defines it (other legs still get an honest ratio).
+    mxu["ceiling_source"] = "mxu" if ceiling <= mxu_rate else "lm"
     for leg in (lm, lm.get("comparison")):
         if isinstance(leg, dict) and leg.get("achieved_tflops_per_chip"):
-            leg["mfu_vs_measured"] = round(
-                float(leg["achieved_tflops_per_chip"]) / ceiling, 4)
+            rate = float(leg["achieved_tflops_per_chip"])
+            if mxu["ceiling_source"] == "lm" and rate >= ceiling:
+                leg["mfu_vs_measured"] = None
+            else:
+                leg["mfu_vs_measured"] = round(rate / ceiling, 4)
 
 
 # Per-leg scalar whitelist for the one-line stdout payload. Everything
@@ -892,7 +935,16 @@ def _compact_legs(record: dict, platform: str,
             keys = _COMPACT_KEYS.get(name, ())
             if headline_only:
                 keys = keys[:2 if name == "lm" else 1]
+            if "incomplete" in leg:
+                # a supervisor kill cut this leg's optional tail: only
+                # the provisional headline scalars exist, the leg must
+                # not read as fully green (it is also excluded from the
+                # archive tie-breaker, see tpu_green_legs), and its
+                # compact width stays bounded for the line budget
+                keys = keys[:2]
             out[name] = {k: leg[k] for k in keys if leg.get(k) is not None}
+            if "incomplete" in leg:
+                out[name]["incomplete"] = True
             comp = leg.get("comparison")
             if name == "lm" and not headline_only and isinstance(comp, dict) \
                     and comp.get("tokens_per_sec_per_chip") is not None:
@@ -1040,7 +1092,37 @@ def _spawn_child(platform: str, skip) -> "subprocess.Popen":
         cwd=os.path.dirname(os.path.abspath(__file__)))
 
 
-def _supervise_legs(platform: str) -> dict:
+def _promote_platform(extra: dict, info: dict, skip: set) -> str:
+    """The accelerator came (back) up mid-run: switch to it.
+
+    Updates the partial record's platform metadata and REQUEUES every
+    leg that ran (or errored) on CPU — those numbers exist only as
+    fallback evidence and the on-chip re-run supersedes them. Legs that
+    already completed on the chip (before a mid-run collapse) keep
+    their results."""
+    platform = info["platform"]
+    peak = _peak_for(info.get("device_kind"))
+    extra.update(platform=platform,
+                 device_kind=info.get("device_kind"),
+                 n_devices=info.get("n_devices"),
+                 peak_bf16_tflops=peak / 1e12 if peak else None,
+                 promoted_mid_run=True)
+    extra.pop("legs_cpu_fallback", None)
+    extra.pop("backend_error", None)
+    requeued = []
+    for name in LEG_ORDER:
+        leg = extra.get(name)
+        if isinstance(leg, dict) and leg.get("leg_platform") == "cpu":
+            del extra[name]
+            skip.discard(name)
+            requeued.append(name)
+    log(f"backend recovered mid-run: {info}; requeued CPU legs "
+        f"{requeued} onto {platform}")
+    _persist_partial(extra)
+    return platform
+
+
+def _supervise_legs(platform: str, reprobe: bool = True) -> dict:
     """Run children until every leg has a result, killing stalls.
 
     Stall = BENCH_PARTIAL.json unchanged for STALL_S (a leg wedged
@@ -1048,17 +1130,70 @@ def _supervise_legs(platform: str) -> dict:
     goes). The hung leg is recorded as an error and skipped on the
     relaunch. Two consecutive children dying without finishing a
     single new leg ⇒ the backend is gone: remaining legs run on CPU.
+
+    While on CPU with `reprobe` (the initial probe FAILED — a down
+    tunnel, not a CPU-only machine), the backend is re-probed between
+    children every REPROBE_INTERVAL_S, and for a bounded extra window
+    after the CPU legs finish: rounds 3 and 4 both burned their driver
+    bench on a tunnel that was down at minute 0 — a tunnel that comes
+    up at any later point now still yields an on-chip capture (the CPU
+    legs are requeued onto the chip). A probe that SUCCEEDS with
+    platform 'cpu' proves there is no accelerator to wait for and
+    disables further probing.
     """
     deadline = time.monotonic() + LEGS_BUDGET_S
+    recovery_deadline = None  # anchored when the CPU legs finish
     skip: set = set()
     fruitless = 0
+    next_reprobe = time.monotonic() + REPROBE_INTERVAL_S
+
+    def reprobe_once():
+        nonlocal platform, reprobe, next_reprobe, fruitless
+        info, err = probe_backend(PROBE_ATTEMPT_S)
+        next_reprobe = time.monotonic() + REPROBE_INTERVAL_S
+        if info is not None and info.get("platform") != "cpu":
+            platform = _promote_platform(extra, info, skip)
+            fruitless = 0
+            return True
+        if info is not None:
+            log("re-probe says this machine is CPU-only; done probing")
+            reprobe = False
+        elif err:
+            log(f"re-probe: backend still down ({err})")
+        return False
+
     while True:
         extra = _load_partial()
         remaining = [name for name in LEG_ORDER
                      if name not in skip
                      and not isinstance(extra.get(name), dict)]
         if not remaining:
+            if recovery_deadline is None:
+                # anchor the post-completion probe window HERE, not at
+                # supervision start: the CPU legs themselves can take
+                # longer than the window, which would leave it expired
+                # the moment it becomes relevant
+                recovery_deadline = time.monotonic() + CPU_RECOVERY_WAIT_S
+            if (reprobe and platform == "cpu"
+                    and any(isinstance(extra.get(n), dict)
+                            and extra[n].get("leg_platform") == "cpu"
+                            for n in LEG_ORDER)
+                    and time.monotonic() < min(deadline, recovery_deadline)):
+                # Every leg is done, but only as CPU fallback. Burn a
+                # bounded slice of the remaining budget probing for the
+                # tunnel: a capture promoted late in the window beats a
+                # CPU record delivered early.
+                time.sleep(min(30, max(0.0,
+                                       next_reprobe - time.monotonic())))
+                if time.monotonic() >= next_reprobe:
+                    reprobe_once()
+                continue
             return extra
+        if (reprobe and platform == "cpu"
+                and time.monotonic() >= next_reprobe
+                and deadline - time.monotonic() > PROBE_ATTEMPT_S + 60):
+            if reprobe_once():
+                continue  # recompute remaining with the requeued legs
         if time.monotonic() > deadline:
             log("leg budget exhausted; finishing with what we have")
             for name in remaining:
@@ -1124,6 +1259,8 @@ def _supervise_legs(platform: str) -> dict:
             extra["legs_cpu_fallback"] = True
             _persist_partial(extra)
             fruitless = 0
+            # don't immediately re-probe the tunnel we just watched die
+            next_reprobe = time.monotonic() + REPROBE_INTERVAL_S
         elif fruitless:
             if fruitless >= 3:
                 # children die before even claiming a leg (broken env,
@@ -1161,12 +1298,7 @@ def main() -> None:
         n_devices = info["n_devices"]
         log(f"backend up after {attempts} attempt(s): {info}")
 
-    peak = None
-    kind_lower = device_kind.lower()
-    for needle, flops in PEAK_FLOPS:
-        if needle in kind_lower:
-            peak = flops
-            break
+    peak = _peak_for(device_kind)
 
     extra = {"platform": platform, "device_kind": device_kind,
              "n_devices": n_devices,
@@ -1179,7 +1311,9 @@ def main() -> None:
     # evidence that the backend came up
     _persist_partial(extra)
 
-    extra = _supervise_legs(platform)
+    # Only keep probing when the initial probe FAILED (a down tunnel can
+    # recover); a successful 'cpu' probe means there is no accelerator.
+    extra = _supervise_legs(platform, reprobe=info is None)
     _apply_honest_ceiling(extra)
 
     headline = extra.get("cifar", {}).get("images_per_sec_per_chip")
@@ -1196,9 +1330,13 @@ def main() -> None:
                            "docs", "BENCH_TPU_LAST_GOOD.json")
 
     def tpu_green_legs(record) -> int:
+        # "incomplete" legs (supervisor killed the optional tail after
+        # the headline persisted) don't count: a degraded capture must
+        # not overwrite a complete archive on a tie.
         return sum(1 for name in LEG_ORDER
                    if isinstance(record.get(name), dict)
                    and "error" not in record[name]
+                   and "incomplete" not in record[name]
                    and record[name].get("leg_platform") == "tpu")
 
     def load_archive():
@@ -1234,16 +1372,15 @@ def main() -> None:
     # a file; the stdout line carries headline + per-leg scalars only.
     # The driver keeps a ~2,000-char tail of stdout — r3's line outgrew
     # it and the round's official record parsed as null.
-    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_DETAIL.json")
     try:
-        _atomic_json_write(detail_path, extra)
+        _atomic_json_write(DETAIL_PATH, extra)
     except OSError as exc:
-        log(f"could not write {detail_path}: {exc}")
+        log(f"could not write {DETAIL_PATH}: {exc}")
 
     compact = {k: extra[k] for k in
                ("platform", "device_kind", "n_devices", "probe_attempts",
-                "peak_bf16_tflops", "legs_cpu_fallback") if k in extra}
+                "peak_bf16_tflops", "legs_cpu_fallback",
+                "promoted_mid_run") if k in extra}
     if extra.get("backend_error"):
         compact["backend_error"] = str(extra["backend_error"])[:80]
     compact["legs"] = _compact_legs(extra, compact.get("platform"))

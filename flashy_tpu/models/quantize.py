@@ -59,7 +59,8 @@ _CONTRACT_AXES = {
 }
 
 
-def quantize_lm_params(params: tp.Any) -> tp.Any:
+def quantize_lm_params(params: tp.Any, *,
+                       keep_embed_dense: bool = False) -> tp.Any:
     """Quantize a TransformerLM parameter tree's matmul kernels to int8.
 
     Accepts the full variables dict ({"params": ...}) or the inner
@@ -67,6 +68,12 @@ def quantize_lm_params(params: tp.Any) -> tp.Any:
     {"q": int8, "scale": f32}. Norms, biases, and MoE routers stay
     full precision. The result decodes through `models.decoding.generate`
     unchanged; use `dequantize_lm_params` to recover dense weights.
+
+    `keep_embed_dense=True` leaves the tied embedding/LM-head table in
+    full precision: the head logits feed the softmax directly, so its
+    quantization error lands on the output distribution with no
+    downstream matmul to wash it out — the standard escape hatch when
+    int8 perplexity regresses.
     """
     wrapped = isinstance(params, dict) and set(params) == {"params"}
     tree = params["params"] if wrapped else params
@@ -86,7 +93,8 @@ def quantize_lm_params(params: tp.Any) -> tp.Any:
         for name, child in node.items():
             p = path + (name,)
             if name == "embed" and not isinstance(child, dict):
-                out[name] = _quantize(child, _CONTRACT_AXES["embed"])
+                out[name] = (child if keep_embed_dense
+                             else _quantize(child, _CONTRACT_AXES["embed"]))
             elif name == "kernel" and len(path) >= 2 \
                     and (path[-2], path[-1]) in _CONTRACT_AXES:
                 out[name] = _quantize(child, axes((path[-2], path[-1])))

@@ -354,6 +354,21 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             "remaining axes.", q.shape[0], tuple(batch_axes), full_ways,
             tuple(use_batch_axes))
     spec = P(tuple(use_batch_axes) if use_batch_axes else None, axis, None, None)
+    if (impl == "fused" and jax.default_backend() == "cpu"
+            and mesh.devices.size >= len(jax.devices())):
+        # Interpret-mode deadlock guard: on the CPU backend the fused
+        # kernel's simulated RDMA semaphore waits each occupy a slot of
+        # XLA's host thread pool, so a mesh covering every host device
+        # starves the pool and hangs forever. Fall back to the scan ring
+        # (identical contract and numerics) instead of deadlocking; the
+        # fused path still raises if called directly (ring_fused).
+        import logging
+        logging.getLogger(__name__).warning(
+            "ring_self_attention: impl='fused' on the CPU backend with a "
+            "%d-device mesh covering all %d host devices would deadlock "
+            "in interpret mode; falling back to impl='scan'.",
+            mesh.devices.size, len(jax.devices()))
+        impl = "scan"
     if impl == "fused":
         from .ring_fused import fused_ring_attention
         mesh_axes = tuple((name, mesh.shape[name])

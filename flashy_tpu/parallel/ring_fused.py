@@ -47,6 +47,7 @@
 # consumes.
 """Single-kernel ring attention: RDMA K/V rotation fused with flash."""
 import functools
+import math
 import typing as tp
 
 import jax
@@ -58,6 +59,15 @@ from . import ring as _ring
 
 NEG_INF = -1e30
 LANES = 128
+# Reserved collective id for the fused-ring kernel's cross-device
+# barrier semaphore. Any OTHER concurrently-live pallas collective in
+# the same program must use a different id (Mosaic keys the shared
+# barrier semaphore off this value).
+FUSED_RING_COLLECTIVE_ID = 7
+# Admission budget for the kernel's resident VMEM tiles. TPU cores have
+# ~16 MiB of VMEM; leave headroom for Mosaic's own spills and the
+# pipeline's double buffering of the Q/out blocks.
+VMEM_BUDGET = 12 * 1024 * 1024
 
 if _attn._PALLAS_AVAILABLE:
     from jax.experimental import pallas as pl
@@ -232,11 +242,8 @@ def _fused_forward(q, k, v, axis_name: str, mesh_axes, causal: bool,
     bh = batch * heads
     qf, kf, vf = (_attn._fold(x) for x in (q, k, v))
 
-    block_q = _attn._dividing_block(t_loc) or t_loc
-    # VMEM guard: the f32 score tile is [block_q, t_loc]; keep it and
-    # the K/V tiles comfortably under the ~16 MiB budget.
-    while block_q > 128 and block_q * t_loc * 4 > 8 * 1024 * 1024:
-        block_q //= 2
+    block_q, _ = _vmem_plan(t_loc, dim, q.dtype.itemsize, k.dtype.itemsize,
+                            v.dtype.itemsize)
     n_q = t_loc // block_q
 
     kernel = functools.partial(
@@ -249,8 +256,8 @@ def _fused_forward(q, k, v, axis_name: str, mesh_axes, causal: bool,
         grid=(bh, n_q, n_steps),
         in_specs=[
             pl.BlockSpec((1, block_q, dim), lambda b, qi, s: (b, qi, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # local K (RDMA source)
-            pl.BlockSpec(memory_space=pltpu.ANY),   # local V
+            pl.BlockSpec(memory_space=pl.ANY),   # local K (RDMA source)
+            pl.BlockSpec(memory_space=pl.ANY),   # local V
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, dim), lambda b, qi, s: (b, qi, 0)),
@@ -259,8 +266,8 @@ def _fused_forward(q, k, v, axis_name: str, mesh_axes, causal: bool,
             # outputs: pallas scratch cannot be ANY-space under the
             # interpret machinery, and an output expresses the same
             # whole-kernel-lifetime HBM allocation.
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_loc, dim), q.dtype, vma=vma),
@@ -280,7 +287,7 @@ def _fused_forward(q, k, v, axis_name: str, mesh_axes, causal: bool,
             pltpu.SemaphoreType.REGULAR((max(1, n_steps),)),  # ready
         ],
         compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=7),
+            has_side_effects=True, collective_id=FUSED_RING_COLLECTIVE_ID),
         # 'eager' DMA execution: the senders here intentionally defer
         # their send-semaphore waits to the end of the kernel, which the
         # default 'on_wait' interpret scheduling would deadlock on (the
@@ -292,11 +299,42 @@ def _fused_forward(q, k, v, axis_name: str, mesh_axes, causal: bool,
     return _attn._unfold(out, batch, heads), lse_rows
 
 
-def _supported(t_loc: int, dim: int) -> bool:
-    """Shapes the fused kernel handles: 128-aligned T_loc that fits the
-    single-tile K/V layout."""
-    return (_attn._PALLAS_AVAILABLE and t_loc % 128 == 0
-            and t_loc * dim * 4 <= 8 * 1024 * 1024)
+def _vmem_plan(t_loc: int, dim: int, q_itemsize: int = 4,
+               k_itemsize: int = 4, v_itemsize: int = 4
+               ) -> tp.Tuple[int, int]:
+    """Pick block_q and account the kernel's resident VMEM.
+
+    Sums every tile live at once inside one grid iteration — K tile, V
+    tile, f32 score tile [block_q, t_loc], running max + normalizer,
+    f32 accumulator, and the pipelined Q / out / lse blocks — and
+    shrinks block_q until the total fits `VMEM_BUDGET`. Returns
+    (block_q, total_bytes_at_that_block_q)."""
+    def total(bq: int) -> int:
+        k_tile = t_loc * dim * k_itemsize
+        v_tile = t_loc * dim * v_itemsize
+        score = bq * t_loc * 4            # f32 scores + probs
+        state = 2 * bq * LANES * 4        # running max + normalizer
+        acc = bq * dim * 4                # f32 accumulator
+        q_blk = bq * dim * q_itemsize
+        o_blk = bq * dim * q_itemsize + bq * LANES * 4   # out + lse
+        return k_tile + v_tile + score + state + acc + q_blk + o_blk
+
+    block_q = _attn._dividing_block(t_loc) or t_loc
+    while block_q > 128 and total(block_q) > VMEM_BUDGET:
+        block_q //= 2
+    return block_q, total(block_q)
+
+
+def _supported(t_loc: int, dim: int, q_itemsize: int = 4,
+               k_itemsize: int = 4, v_itemsize: int = 4) -> bool:
+    """Shapes the fused kernel handles: 128-aligned T_loc whose full
+    resident tile set (K+V tiles, score tile, softmax state,
+    accumulator, Q/out blocks) fits the VMEM budget at the smallest
+    block_q."""
+    if not (_attn._PALLAS_AVAILABLE and t_loc % 128 == 0):
+        return False
+    _, total = _vmem_plan(t_loc, dim, q_itemsize, k_itemsize, v_itemsize)
+    return total <= VMEM_BUDGET
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -319,17 +357,44 @@ def fused_ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _fused_fwd_impl(q, k, v, axis_name, causal, mesh_axes):
     t_loc, dim = q.shape[1], q.shape[3]
-    if not _supported(t_loc, dim):
+    if not _supported(t_loc, dim, q.dtype.itemsize, k.dtype.itemsize,
+                      v.dtype.itemsize):
         raise ValueError(
             f"fused ring attention needs pallas and a 128-aligned local "
-            f"sequence block whose K/V tile fits VMEM "
-            f"(t_local * head_dim * 4 <= 8 MiB); got t_local={t_loc}, "
-            f"head_dim={dim}, pallas={_attn._PALLAS_AVAILABLE}. "
+            f"sequence block whose resident tiles (K+V+scores+state) fit "
+            f"the {VMEM_BUDGET >> 20} MiB VMEM budget; got "
+            f"t_local={t_loc}, head_dim={dim}, "
+            f"pallas={_attn._PALLAS_AVAILABLE}. "
             f"Use impl='scan' for these shapes.")
     if mesh_axes is None:
         # Single-axis ring: the flat logical id IS the ring index.
         mesh_axes = ((axis_name, int(jax.lax.psum(1, axis_name))),)
-    interpret = jax.default_backend() == "cpu"
+    backend = jax.default_backend()
+    if backend not in ("cpu", "tpu"):
+        # The kernel is Mosaic-TPU; on GPU it would fail deep inside the
+        # lowering with an opaque error. Refuse up front.
+        raise NotImplementedError(
+            f"fused ring attention lowers via Mosaic (TPU) or the pallas "
+            f"interpret machinery (CPU); backend {backend!r} is not "
+            f"supported. Use impl='scan'.")
+    interpret = backend == "cpu"
+    if interpret:
+        # In interpret mode every simulated device's RDMA semaphore
+        # waits occupy a slot of XLA's host intra-op thread pool. A mesh
+        # spanning every host device starves the pool and the kernel
+        # hangs forever (no Mosaic analogue — real TPUs have dedicated
+        # DMA engines). Refuse instead of deadlocking; callers going
+        # through `ring_self_attention` are transparently re-routed to
+        # impl='scan' before reaching this point.
+        mesh_size = math.prod(size for _, size in mesh_axes)
+        if mesh_size >= len(jax.devices()):
+            raise RuntimeError(
+                f"fused ring attention in interpret mode (CPU backend) "
+                f"over a {mesh_size}-device mesh covering every host "
+                f"device ({len(jax.devices())} visible) would deadlock: "
+                f"the simulated RDMA semaphore waits starve XLA's host "
+                f"thread pool. Leave at least one host device outside "
+                f"the mesh, or use impl='scan'.")
     return _fused_forward(q, k, v, axis_name, mesh_axes, causal, interpret)
 
 

@@ -27,6 +27,9 @@ def test_bench_supervisor_kills_hung_leg_and_finishes(tmp_path):
         JAX_PLATFORMS="cpu",
         FLASHY_TPU_BENCH_LEGS="smoke,mxu",
         FLASHY_TPU_BENCH_FAKE_HANG="smoke",
+        # own state dir: must not race a concurrent bench / xdist peer
+        # on the repo-root BENCH_PARTIAL.json / BENCH_DETAIL.json
+        FLASHY_TPU_BENCH_STATE_DIR=str(tmp_path),
         # 90s, not 30: the stall window also covers the relaunched
         # child's jax import and its real (fast) mxu leg on a possibly
         # loaded machine — only the first child's window is pure sleep
@@ -49,14 +52,14 @@ def test_bench_supervisor_kills_hung_leg_and_finishes(tmp_path):
     assert "measured_bf16_tflops" in legs["mxu"], legs["mxu"]
     assert payload["value"] is None and proc.returncode == 1
     # the full record (untruncated errors, every field) landed on disk
-    with open(os.path.join(REPO, "BENCH_DETAIL.json")) as f:
+    with open(os.path.join(str(tmp_path), "BENCH_DETAIL.json")) as f:
         detail = json.load(f)
     assert "hung" in detail["smoke"]["error"]
     assert "_current_leg" not in detail
 
 
 @pytest.mark.slow
-def test_supervisor_preserves_provisional_headline():
+def test_supervisor_preserves_provisional_headline(tmp_path):
     """A leg whose headline number is already persisted (provisional)
     must survive a kill during the leg's optional tail — the lm
     comparison sub-leg's compile is exactly where a tunnel wedge
@@ -66,6 +69,7 @@ def test_supervisor_preserves_provisional_headline():
         JAX_PLATFORMS="cpu",
         FLASHY_TPU_BENCH_LEGS="smoke",
         FLASHY_TPU_BENCH_FAKE_HANG_TAIL="smoke",
+        FLASHY_TPU_BENCH_STATE_DIR=str(tmp_path),
         # covers the child's jax import on a loaded machine too
         FLASHY_TPU_BENCH_STALL="60",
         FLASHY_TPU_BENCH_BUDGET="300",
@@ -74,12 +78,91 @@ def test_supervisor_preserves_provisional_headline():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")], env=env, cwd=REPO,
         capture_output=True, text=True, timeout=400)
-    with open(os.path.join(REPO, "BENCH_DETAIL.json")) as f:
+    with open(os.path.join(str(tmp_path), "BENCH_DETAIL.json")) as f:
         detail = json.load(f)
     leg = detail["smoke"]
     assert leg["tokens_per_sec_per_chip"] == 1.0, leg  # headline kept
     assert "hung" in leg["incomplete"], leg             # tail blamed
     assert "provisional" not in leg and "error" not in leg, leg
+    # an incomplete leg is flagged in the compact payload and must not
+    # count as fully green for the archive tie-breaker
+    import bench
+    compact = bench._compact_legs(detail, "cpu")
+    assert compact["smoke"]["incomplete"] is True
+
+
+def test_supervisor_reprobes_and_promotes_mid_run(monkeypatch, tmp_path):
+    """Rounds 3 and 4 burned their driver bench on a tunnel that was
+    down at probe time: the supervisor must keep re-probing BETWEEN
+    children, and when the backend appears mid-run, requeue the legs
+    that fell back to CPU so the capture is promoted to the chip."""
+    import bench
+
+    partial = str(tmp_path / "BENCH_PARTIAL.json")
+    monkeypatch.setattr(bench, "PARTIAL_PATH", partial)
+    monkeypatch.setattr(bench, "REPROBE_INTERVAL_S", 0.0)
+    monkeypatch.setattr(bench, "LEG_ORDER", ("smoke", "mxu"))
+    monkeypatch.setattr(bench, "LEGS_BUDGET_S", 600.0)
+
+    # probe: down on the first between-children check, up on the second
+    probes = [(None, "tunnel down"),
+              ({"platform": "tpu", "device_kind": "TPU v5 lite",
+                "n_devices": 1}, None)]
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda timeout: probes.pop(0))
+
+    class FakeChild:
+        """Stands in for one bench child: completes every remaining leg
+        on the platform it was spawned with, then exits 0."""
+        pid = 0
+        returncode = 0
+
+        def __init__(self, platform, skip):
+            extra = bench._load_partial()
+            for name in bench.LEG_ORDER:
+                if name not in skip and not isinstance(extra.get(name), dict):
+                    extra[name] = {"ok": 1, "leg_platform": platform}
+            bench._persist_partial(extra)
+
+        def poll(self):
+            return 0
+
+    monkeypatch.setattr(bench, "_spawn_child", FakeChild)
+
+    extra = bench._supervise_legs("cpu")
+    # first child ran both legs on cpu; the second probe promoted the
+    # run and requeued them; the second child re-ran them on tpu
+    assert extra["smoke"]["leg_platform"] == "tpu"
+    assert extra["mxu"]["leg_platform"] == "tpu"
+    assert extra["promoted_mid_run"] is True
+    assert extra["platform"] == "tpu"
+    assert extra["peak_bf16_tflops"] == 197.0
+    assert not probes  # both probe outcomes consumed
+
+
+def test_promote_platform_requeues_only_cpu_legs(monkeypatch, tmp_path):
+    import bench
+
+    monkeypatch.setattr(bench, "PARTIAL_PATH",
+                        str(tmp_path / "BENCH_PARTIAL.json"))
+    extra = {
+        "platform": "cpu", "legs_cpu_fallback": True,
+        "backend_error": "down",
+        "smoke": {"ok": 1, "leg_platform": "cpu"},
+        "mxu": {"error": "x", "leg_platform": "cpu"},
+        "cifar": {"ok": 1, "leg_platform": "tpu"},  # pre-collapse capture
+    }
+    skip = {"mxu"}
+    platform = bench._promote_platform(
+        extra, {"platform": "tpu", "device_kind": "TPU v5p",
+                "n_devices": 4}, skip)
+    assert platform == "tpu"
+    assert "smoke" not in extra and "mxu" not in extra  # requeued
+    assert extra["cifar"]["leg_platform"] == "tpu"      # kept
+    assert "mxu" not in skip
+    assert "legs_cpu_fallback" not in extra
+    assert extra["n_devices"] == 4
+    assert extra["peak_bf16_tflops"] == 459.0
 
 
 def test_compact_line_fits_driver_tail_worst_case():
@@ -101,10 +184,13 @@ def test_compact_line_fits_driver_tail_worst_case():
         "comparison": {"tokens_per_sec_per_chip": 39483.2},
     }
     record = {name: dict(fat_leg) for name in bench.LEG_ORDER}
+    # a mid-tail kill marks a leg incomplete: the flag costs line budget
+    # (its scalars are trimmed to the headline pair in exchange)
+    record["lm"]["incomplete"] = "leg hung (no progress for 480s; killed)"
     compact = {
         "platform": "cpu", "device_kind": "TPU v5 lite chip",
         "n_devices": 8, "probe_attempts": 3, "peak_bf16_tflops": 197.0,
-        "legs_cpu_fallback": True,
+        "legs_cpu_fallback": True, "promoted_mid_run": True,
         "backend_error": "x" * 80,
         "legs": bench._compact_legs(record, "cpu"),
         "last_good_tpu": {"captured_at": "2026-07-29T23:59:59",
@@ -134,8 +220,22 @@ def test_honest_ceiling_never_exceeds_one():
     }
     bench._apply_honest_ceiling(record)
     assert record["mxu"]["ceiling_bf16_tflops"] == 58.63
-    assert record["lm"]["mfu_vs_measured"] == 1.0
+    # the lm leg itself set the ceiling: flag the source, and publish
+    # no ratio for the self-referential leg (a 1.0 would masquerade as
+    # an independent measurement)
+    assert record["mxu"]["ceiling_source"] == "lm"
+    assert record["lm"]["mfu_vs_measured"] is None
     assert record["lm"]["comparison"]["mfu_vs_measured"] < 1.0
+
+    # ...while an MXU-sourced ceiling keeps honest sub-1.0 ratios
+    mxu_record = {
+        "mxu": {"measured_bf16_tflops": 80.0, "leg_platform": "tpu"},
+        "lm": {"achieved_tflops_per_chip": 58.63, "mfu_vs_measured": 0.7,
+               "leg_platform": "tpu"},
+    }
+    bench._apply_honest_ceiling(mxu_record)
+    assert mxu_record["mxu"]["ceiling_source"] == "mxu"
+    assert mxu_record["lm"]["mfu_vs_measured"] == round(58.63 / 80.0, 4)
 
     # a CPU-fallback lm leg must NOT be normalized against a TPU mxu —
     # and without an independent same-platform MXU rate the ratio would

@@ -103,3 +103,33 @@ def test_router_and_norms_stay_dense():
     assert not is_quantized(qp["block_0"]["moe"]["router"]["kernel"])
     assert qp["block_0"]["norm1"]["scale"].dtype == jnp.float32
     assert is_quantized(qp["block_0"]["moe"]["w_up"])
+
+
+def test_keep_embed_dense_escape_hatch():
+    # The tied embedding/head table feeds the softmax directly, so int8
+    # error there lands on the output distribution; keep_embed_dense
+    # leaves it full precision while still quantizing the block kernels.
+    cfg, model, params, tokens = _model()
+    qp = quantize_lm_params(params, keep_embed_dense=True)
+    inner = qp["params"]
+    assert not is_quantized(inner["embed"])
+    assert inner["embed"].dtype == params["params"]["embed"].dtype
+    assert is_quantized(inner["block_0"]["mlp"]["up"]["kernel"])
+    # the mixed tree decodes through the same step path, and a dense
+    # head tracks the full-precision logits strictly better than the
+    # fully-quantized tree does
+    positions = jnp.broadcast_to(
+        jnp.arange(tokens.shape[1], dtype=jnp.int32)[None], tokens.shape)
+
+    def cos_to_ref(tree):
+        logits_f, _ = _apply_step(model, params, cfg, tokens, positions,
+                                  init_cache(cfg, 2, 32), jnp.int32(0))
+        logits_q, _ = _apply_step(model, tree, cfg, tokens, positions,
+                                  init_cache(cfg, 2, 32), jnp.int32(0))
+        a = np.asarray(logits_f, np.float64).reshape(-1)
+        b = np.asarray(logits_q, np.float64).reshape(-1)
+        return np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12)
+
+    full_q = quantize_lm_params(params)
+    assert cos_to_ref(qp) >= cos_to_ref(full_q) - 1e-9
+    assert cos_to_ref(qp) > 0.999

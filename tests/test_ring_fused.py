@@ -83,3 +83,43 @@ def test_fused_ring_grad_matches_dense():
     for g, r in zip(grads, ref_grads):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r),
                                    rtol=1e-3, atol=1e-4)
+
+
+def test_all_device_interpret_mesh_falls_back_to_scan(caplog):
+    # r4 regression class: an interpret-mode fused ring over EVERY host
+    # device starves XLA's thread pool and hangs forever. The shard_map
+    # entry point must transparently re-route to the scan ring...
+    import logging
+    mesh = make_mesh({"seq": 2, "data": 4}, devices=jax.devices())
+    rng = np.random.default_rng(11)
+    shape = (4, 256, 1, 64)
+    q, k, v = (jnp.asarray(rng.normal(size=shape).astype(np.float32))
+               for _ in range(3))
+    with caplog.at_level(logging.WARNING, "flashy_tpu.parallel.ring"):
+        out = ring_self_attention(q, k, v, mesh=mesh, causal=True,
+                                  batch_axes=("data",), impl="fused")
+    assert any("falling back" in r.message for r in caplog.records)
+    ref = _dense_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_all_device_interpret_mesh_direct_call_raises():
+    # ...and the direct fused entry point refuses loudly instead of
+    # silently deadlocking.
+    import functools
+    from jax.sharding import PartitionSpec as P
+    from flashy_tpu.parallel.ring_fused import fused_ring_attention
+
+    mesh = make_mesh({"seq": 2, "data": 4}, devices=jax.devices())
+    rng = np.random.default_rng(12)
+    shape = (4, 256, 1, 64)
+    q, k, v = (jnp.asarray(rng.normal(size=shape).astype(np.float32))
+               for _ in range(3))
+    spec = P("data", "seq", None, None)
+    mesh_axes = tuple((name, mesh.shape[name]) for name in mesh.axis_names)
+    fn = functools.partial(fused_ring_attention, axis_name="seq",
+                           causal=True, mesh_axes=mesh_axes)
+    with pytest.raises(Exception, match="deadlock"):
+        jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)(q, k, v)
