@@ -8,7 +8,13 @@ linter:
 		python -m compileall -q flashy_tpu tests examples bench.py __graft_entry__.py; \
 	fi
 
+# Fast lane (default): everything but the `slow` marker — interpret-mode
+# kernel grids, multi-process spawns, whole-example subprocesses, big
+# SPMD compiles. Target: a few minutes. `tests-all` is the full matrix.
 tests:
+	python -m pytest tests -x -q -m "not slow"
+
+tests-all:
 	python -m pytest tests -x -q
 
 coverage:
@@ -26,4 +32,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests coverage bench docs native dist
+.PHONY: default linter tests tests-all coverage bench docs native dist

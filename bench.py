@@ -1259,7 +1259,11 @@ def _supervise_legs(platform: str, reprobe: bool = True) -> dict:
             extra["legs_cpu_fallback"] = True
             _persist_partial(extra)
             fruitless = 0
-            # don't immediately re-probe the tunnel we just watched die
+            # The accelerator existed (initial probe succeeded), so a
+            # mid-run collapse is recoverable: re-arm probing even if
+            # main() started us with reprobe=False — but not
+            # immediately against the tunnel we just watched die.
+            reprobe = True
             next_reprobe = time.monotonic() + REPROBE_INTERVAL_S
         elif fruitless:
             if fruitless >= 3:

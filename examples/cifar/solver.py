@@ -35,6 +35,11 @@ class Solver(flashy_tpu.BaseSolver):
         variables = self.model.init(jax.random.PRNGKey(0),
                                     jnp.zeros((1, 32, 32, 3)), train=False)
         steps_per_epoch = max(1, len(loaders["train"]))
+        if cfg.max_batches is not None:
+            # budgeted runs (max_batches caps each stage) must anneal
+            # over the steps that will actually run, or the cosine never
+            # leaves its peak and the run plateaus early
+            steps_per_epoch = min(steps_per_epoch, cfg.max_batches)
         schedule = optax.cosine_decay_schedule(
             cfg.lr, cfg.epochs * steps_per_epoch)
         self.optim = optax.chain(

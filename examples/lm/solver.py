@@ -23,12 +23,20 @@ from flashy_tpu.utils import device_sync
 def synthetic_token_stream(vocab_size: int, seed: int = 0):
     """Deterministic Markov-ish token generator: next-token structure a
     model can actually learn, so loss curves are meaningful without a
-    real corpus (zero-egress environments)."""
+    real corpus (zero-egress environments).
+
+    `subset` namespaces independent sample streams over the SAME token
+    distribution (the Markov transition table depends only on `seed`):
+    train draws subset 0, eval subset 1. The streams are separated by
+    feeding (seed, subset, step) to numpy's SeedSequence — proper
+    entropy hashing, unlike an arithmetic step offset, which collides
+    once training steps walk into the offset range."""
     rng = np.random.default_rng(seed)
     mixing = rng.integers(1, vocab_size - 1, size=257)
 
-    def batch(batch_size: int, seq_len: int, step: int) -> np.ndarray:
-        gen = np.random.default_rng(seed * 1_000_003 + step)
+    def batch(batch_size: int, seq_len: int, step: int,
+              subset: int = 0) -> np.ndarray:
+        gen = np.random.default_rng([seed, subset, step])
         tokens = np.empty((batch_size, seq_len), np.int64)
         tokens[:, 0] = gen.integers(0, vocab_size, batch_size)
         noise = gen.random((batch_size, seq_len)) < 0.15
@@ -166,11 +174,11 @@ class LMSolver(flashy_tpu.BaseSolver):
                                      "grad_norm": ".2f", "tokens_per_sec": ".0f"})
 
     def batch_at(self, step: int, eval_set: bool = False) -> jax.Array:
-        # Held-out data: the eval stream draws from a disjoint step range
-        # (the generator is seeded per step, so offsetting never collides
-        # with training steps).
+        # Held-out data: the eval stream is an independently-seeded
+        # subset of the same distribution (SeedSequence-namespaced, not
+        # a step offset — see synthetic_token_stream).
         host = self._stream(self.cfg.batch_size, self.cfg.seq_len,
-                            step + (1 << 30 if eval_set else 0))
+                            step, subset=1 if eval_set else 0)
         return shard_batch(jnp.asarray(host), self.mesh,
                            batch_axes=("data", "fsdp"))
 

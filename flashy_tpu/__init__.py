@@ -24,7 +24,7 @@ Experiment management (XP folders, signatures, history) is built in via
 the :mod:`flashy_tpu.xp` module — no external launcher required.
 """
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 from . import distrib  # noqa
 from . import adversarial  # noqa

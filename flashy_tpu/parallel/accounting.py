@@ -1,0 +1,80 @@
+# Compile-time collective accounting. Multi-chip correctness tests on a
+# virtual mesh prove numerics, but they cannot catch a sharding spec
+# that silently regresses to replication — the program stays *correct*
+# and quietly stops communicating (or communicates far more). The
+# compiled HLO can: every cross-device byte appears as a collective
+# instruction whose output shape is statically known. This module turns
+# a compiled step into {collective -> (count, bytes)} so tests (and
+# users) can assert analytic expectations per mesh shape, e.g.:
+#   * FSDP   — params all-gathered ~once per step; grads reduced
+#   * TP     — >= 2 activation all-reduces per transformer block
+#   * ring   — K/V bytes x (n-1) hops of collective-permute
+#   * EP     — token dispatch/combine all-to-alls
+# (The reference has no analogue: its NCCL calls are explicit, so
+# "silently replicated" cannot happen there; under XLA's partitioner it
+# can, which is why this exists. SURVEY §5 race/failure tooling.)
+"""Extract per-collective op counts + byte totals from compiled HLO."""
+import re
+import typing as tp
+
+COLLECTIVE_OPS = ("all-gather", "all-reduce", "reduce-scatter",
+                  "collective-permute", "all-to-all", "collective-broadcast")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+# `%name = <shape-or-tuple> <op>(operands...)`; `-start` covers async
+# pairs (count the start, not the matching -done, to avoid doubling).
+# The shape group is a lazy .*?: long tuple shapes embed `/*index=N*/`
+# comments (which contain '='), so a character class excluding '='
+# silently skips exactly the biggest collectives.
+_INSTR_RE = re.compile(
+    r"=\s+(?P<shape>.*?)\s+(?P<op>%s)(?:-start)?\("
+    % "|".join(COLLECTIVE_OPS))
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z]+\d*)\[(?P<dims>[\d,]*)\]")
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Total bytes of a shape string, summing tuple elements."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape_text):
+        itemsize = _DTYPE_BYTES.get(m.group("dtype"))
+        if itemsize is None:
+            continue  # token[] / opaque shapes carry no payload
+        n = 1
+        dims = m.group("dims")
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * itemsize
+    return total
+
+
+def collective_stats(compiled: tp.Any) -> tp.Dict[str, tp.Dict[str, int]]:
+    """Per-collective instruction counts and output-byte totals.
+
+    `compiled` is a `jax.stages.Compiled` (from `jit(f).lower(...)
+    .compile()`) or its `as_text()` string. Bytes are the instruction
+    OUTPUT shape summed over the program — a device-count-independent
+    proxy for traffic that is exactly what regresses when a sharding
+    spec silently falls back to replication. Async `-start`/`-done`
+    pairs are counted once.
+    """
+    text = compiled if isinstance(compiled, str) else compiled.as_text()
+    stats = {op: {"count": 0, "bytes": 0} for op in COLLECTIVE_OPS}
+    for line in text.splitlines():
+        m = _INSTR_RE.search(line)
+        if not m:
+            continue
+        entry = stats[m.group("op")]
+        entry["count"] += 1
+        entry["bytes"] += _shape_bytes(m.group("shape"))
+    return stats
+
+
+def total_collective_bytes(compiled: tp.Any) -> int:
+    """Sum of `collective_stats` bytes over every collective kind."""
+    return sum(e["bytes"] for e in collective_stats(compiled).values())

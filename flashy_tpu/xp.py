@@ -341,11 +341,15 @@ class _EntryPoint:
         return get_xp_from_sig(sig, root=self.dir)
 
     def __call__(self, argv: tp.Optional[tp.Sequence[str]] = None):
-        # Platform pinning via env (e.g. FLASHY_TPU_PLATFORM=cpu for
-        # localhost multi-process tests); see utils.pin_platform.
-        if os.environ.get("FLASHY_TPU_PLATFORM"):
-            from .utils import pin_platform
-            pin_platform()
+        # Platform pinning via env (FLASHY_TPU_PLATFORM=cpu or plain
+        # JAX_PLATFORMS=cpu). Applied unconditionally: site
+        # customizations that autoload an accelerator plugin override
+        # the JAX_PLATFORMS env var at interpreter start, so a user
+        # launching `JAX_PLATFORMS=cpu python train.py ...` would
+        # otherwise silently initialize (and hang on) the accelerator
+        # backend. No-op when neither var is set.
+        from .utils import pin_platform
+        pin_platform()
         argv = list(sys.argv[1:] if argv is None else argv)
         if "--help" in argv or "-h" in argv:
             print(self._usage())

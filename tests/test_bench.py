@@ -257,3 +257,53 @@ def test_honest_ceiling_never_exceeds_one():
     }
     bench._apply_honest_ceiling(no_mxu)
     assert no_mxu["lm"]["mfu_vs_measured"] is None
+
+
+def test_midrun_collapse_rearms_reprobe(monkeypatch, tmp_path):
+    """Backend up at start (reprobe disabled), dies mid-run (two
+    fruitless children -> CPU fallback), then recovers: the fallback
+    must RE-ARM probing so the recovered chip takes the remaining legs
+    — the r5 review finding that reprobe=False at start would otherwise
+    permanently disable the recovery machinery."""
+    import bench
+
+    monkeypatch.setattr(bench, "PARTIAL_PATH",
+                        str(tmp_path / "BENCH_PARTIAL.json"))
+    monkeypatch.setattr(bench, "REPROBE_INTERVAL_S", 0.0)
+    monkeypatch.setattr(bench, "LEG_ORDER", ("smoke",))
+    monkeypatch.setattr(bench, "LEGS_BUDGET_S", 600.0)
+
+    probes = [({"platform": "tpu", "device_kind": "TPU v5 lite",
+                "n_devices": 1}, None)]
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda timeout: probes.pop(0))
+
+    spawns = []
+
+    class FakeChild:
+        pid = 0
+
+        def __init__(self, platform, skip):
+            spawns.append(platform)
+            if len(spawns) <= 2:
+                self.returncode = 1  # dies without completing any leg
+                return
+            self.returncode = 0
+            extra = bench._load_partial()
+            for name in bench.LEG_ORDER:
+                if name not in skip and not isinstance(extra.get(name), dict):
+                    extra[name] = {"ok": 1, "leg_platform": platform}
+            bench._persist_partial(extra)
+
+        def poll(self):
+            return 0
+
+    monkeypatch.setattr(bench, "_spawn_child", FakeChild)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    # initial probe succeeded on tpu -> main() passes reprobe=False
+    extra = bench._supervise_legs("tpu", reprobe=False)
+    assert spawns[:2] == ["tpu", "tpu"]      # the two fruitless children
+    assert "tpu" in spawns[2:]               # recovery re-ran on the chip
+    assert extra["smoke"]["leg_platform"] == "tpu"
+    assert not probes                        # the re-probe actually fired

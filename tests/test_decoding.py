@@ -4,6 +4,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from flashy_tpu.models import TransformerConfig, TransformerLM
 from flashy_tpu.models.decoding import generate
@@ -17,6 +18,7 @@ def _model_and_params(attention="dense"):
     return model, params
 
 
+@pytest.mark.slow
 def test_greedy_generate_matches_naive():
     model, params = _model_and_params()
     prompt = jnp.asarray(
@@ -57,6 +59,7 @@ def test_sampled_generate_valid_tokens():
     assert not np.array_equal(np.asarray(out2), arr)
 
 
+@pytest.mark.slow
 def test_greedy_generate_scan_stacked_matches_naive():
     cfg = TransformerConfig(vocab_size=64, dim=32, num_layers=3, num_heads=4,
                             attention="dense", max_seq_len=64, scan_layers=True)
@@ -91,6 +94,7 @@ def _moe_model(scan_layers=False):
     return model, params
 
 
+@pytest.mark.slow
 def test_greedy_generate_moe_matches_naive():
     model, params = _moe_model()
     prompt = jnp.asarray(
@@ -105,6 +109,7 @@ def test_greedy_generate_moe_matches_naive():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(tokens))
 
 
+@pytest.mark.slow
 def test_greedy_generate_moe_scan_stacked():
     model, params = _moe_model(scan_layers=True)
     prompt = jnp.asarray(
@@ -119,6 +124,7 @@ def test_greedy_generate_moe_scan_stacked():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(tokens))
 
 
+@pytest.mark.slow
 def test_moe_prefill_expert_stream_path():
     # long prompts take the expert-streaming branch (N > gather cutoff);
     # it must agree with the training forward exactly like the gather path.

@@ -6,6 +6,7 @@ import os
 import subprocess as sp
 import sys
 
+import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -60,6 +61,7 @@ def test_lm_example(tmp_path):
     assert "generate" in history[0]
 
 
+@pytest.mark.slow
 def test_lm_example_chunked_loss(tmp_path):
     # loss=chunked (ops.losses chunked CE head) through the example's
     # own training path; same train/valid surface as the dense loss.
@@ -87,6 +89,7 @@ def test_lm_example_pipelined(tmp_path):
     assert history[0]["train"]["loss"] > 0
 
 
+@pytest.mark.slow
 def test_lm_solver_pipelined_loss_parity(tmp_path):
     # The example's own train step with mesh.pipe=2 computes the same
     # loss as the unpipelined (pipe=1) solver on identical params+batch.
@@ -151,3 +154,19 @@ def test_cifar_ingestion_override(tmp_path, monkeypatch):
     # env var route finds the same directory
     monkeypatch.setenv("FLASHY_TPU_CIFAR", str(root))
     assert load_cifar10()[4] is True
+
+
+def test_lm_eval_stream_disjoint_from_train():
+    """The held-out stream must be an independently-seeded subset, not a
+    step offset: at IDENTICAL step indices train and eval batches differ,
+    both streams are deterministic, and both share the same Markov
+    transition structure (same seed -> same mixing table)."""
+    from examples.lm.solver import synthetic_token_stream
+
+    stream = synthetic_token_stream(vocab_size=128)
+    for step in (0, 1, 12345):
+        train = stream(4, 64, step, subset=0)
+        evalb = stream(4, 64, step, subset=1)
+        assert not np.array_equal(train, evalb), step
+        np.testing.assert_array_equal(train, stream(4, 64, step, subset=0))
+        np.testing.assert_array_equal(evalb, stream(4, 64, step, subset=1))

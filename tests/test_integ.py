@@ -7,6 +7,8 @@ import os
 import subprocess as sp
 import sys
 
+import pytest
+
 
 def _run(tmpdir, *args, workers=None):
     env = dict(os.environ)
@@ -28,6 +30,7 @@ def _history(tmpdir):
         return json.load(f)
 
 
+@pytest.mark.slow
 def test_integ(tmp_path):
     _run(tmp_path, "--clear", "stop_at=2")
     history = _history(tmp_path)
@@ -46,6 +49,7 @@ def test_integ(tmp_path):
     assert history[-1]["valid"]["mse"] < history[0]["valid"]["mse"]
 
 
+@pytest.mark.slow
 def test_integ_distributed(tmp_path):
     _run(tmp_path, "--clear", "stop_at=2", workers=2)
     history = _history(tmp_path)

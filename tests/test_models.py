@@ -20,6 +20,7 @@ def test_mlp_shapes():
     assert out.shape == (5, 3)
 
 
+@pytest.mark.slow
 def test_resnet18_forward_and_batchstats():
     model = resnet18(num_classes=10)
     variables = model.init(jax.random.PRNGKey(0), jnp.ones((2, 32, 32, 3)),
@@ -86,6 +87,7 @@ def test_transformer_remat_matches():
         np.asarray(remat_model.apply(variables, tokens)), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_transformer_sharded_step_matches_replicated():
     # The flagship oracle: full train step with dp=2, tensor=2, seq=2
     # sharding (ring attention) == replicated dense computation.
@@ -163,6 +165,7 @@ def test_transformer_max_seq_len_enforced():
         model.apply(variables, jnp.ones((1, 16), jnp.int32))
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_matches_replicated():
     mesh = make_mesh({"data": 2, "expert": 2, "tensor": 2})
     cfg = _tiny_cfg(moe_experts=4, moe_top_k=2)
@@ -236,6 +239,7 @@ def test_scan_layers_stacked_params_and_forward():
                                np.asarray(out[0, :-1]), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipelined_apply_matches_scan_forward():
     from jax.sharding import NamedSharding
     from flashy_tpu.models.pipelined import pipelined_apply
@@ -275,6 +279,7 @@ def test_pipelined_apply_matches_scan_forward():
                                    rtol=5e-2, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_moe_sorted_dispatch_matches_einsum():
     from flashy_tpu.models.moe import MoEMLP
     x = jnp.asarray(np.random.default_rng(9).normal(size=(2, 16, 8)),
@@ -304,6 +309,7 @@ def test_moe_sorted_dispatch_matches_einsum():
     assert float(jnp.abs(g_up).max()) > 0
 
 
+@pytest.mark.slow
 def test_pipelined_apply_moe_matches_unpipelined():
     # MoE in the pipeline: expert outputs are exact (capacity high enough
     # that nothing drops); the aux loss is the microbatch-mean estimator.
@@ -347,6 +353,7 @@ def test_pipelined_apply_moe_matches_unpipelined():
     assert np.isfinite(float(gnorm)) and float(gnorm) > 0
 
 
+@pytest.mark.slow
 def test_moe_dropless_matches_einsum_and_drops_nothing():
     from flashy_tpu.models.moe import MoEMLP
     rng = np.random.default_rng(11)
@@ -385,6 +392,7 @@ def test_moe_dropless_matches_einsum_and_drops_nothing():
     assert np.isfinite(float(gnorm)) and float(gnorm) > 0
 
 
+@pytest.mark.slow
 def test_moe_dropless_ep_matches_dropless():
     # The expert-parallel dropless hybrid (capacity-bounded a2a between
     # expert shards + grouped matmul on each local slab) must agree with
@@ -438,6 +446,7 @@ def test_moe_dropless_ep_matches_dropless():
 
 
 @pytest.mark.parametrize("policy", ["dots", "dots_no_batch"])
+@pytest.mark.slow
 def test_remat_policy_matches_full_remat(policy):
     # Selective remat changes what is SAVED, never the math: loss and
     # grads must match the full-remat config bit-for-bit (identical

@@ -305,7 +305,8 @@ class TestChunkedCrossEntropy:
         params = model.init(jax.random.PRNGKey(0), tokens)
         return model, params, tokens
 
-    @pytest.mark.parametrize("chunk", [32, 37, 200])
+    @pytest.mark.parametrize(
+        "chunk", [pytest.param(32, marks=pytest.mark.slow), 37, 200])
     def test_matches_dense_loss_and_grads(self, chunk):
         # chunk=37 does not divide T-1=95 (internal padding path);
         # chunk=200 exceeds T (single padded chunk).

@@ -26,6 +26,7 @@ def _model(scan_layers=False, moe=0):
     return cfg, model, params, tokens
 
 
+@pytest.mark.slow
 def test_roundtrip_error_bounded_by_channel_step():
     _, _, params, _ = _model()
     qp = quantize_lm_params(params)
@@ -49,8 +50,10 @@ def test_roundtrip_error_bounded_by_channel_step():
         lambda a, b: check("leaf", a, b), params, dq)
 
 
-@pytest.mark.parametrize("scan_layers,moe", [(False, 0), (True, 0),
-                                             (False, 2)])
+@pytest.mark.parametrize("scan_layers,moe", [
+    (False, 0),
+    pytest.param(True, 0, marks=pytest.mark.slow),
+    pytest.param(False, 2, marks=pytest.mark.slow)])
 def test_quantized_decode_tracks_full_precision(scan_layers, moe):
     cfg, model, params, tokens = _model(scan_layers, moe)
     qp = quantize_lm_params(params)
@@ -69,8 +72,10 @@ def test_quantized_decode_tracks_full_precision(scan_layers, moe):
     assert cos > 0.999, cos
 
 
-@pytest.mark.parametrize("scan_layers,moe", [(False, 0), (True, 0),
-                                             (False, 2)])
+@pytest.mark.parametrize("scan_layers,moe", [
+    (False, 0),
+    pytest.param(True, 0, marks=pytest.mark.slow),
+    (False, 2)])
 def test_quantized_generate_runs_all_layouts(scan_layers, moe):
     cfg, model, params, tokens = _model(scan_layers, moe)
     qp = quantize_lm_params(params)

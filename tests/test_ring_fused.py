@@ -30,6 +30,7 @@ def _dense_attention(q, k, v, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_fused_ring_matches_dense(causal):
     mesh = make_mesh({"seq": 4, "data": 1}, devices=jax.devices()[:4])
     rng = np.random.default_rng(7)
@@ -44,6 +45,7 @@ def test_fused_ring_matches_dense(causal):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fused_ring_two_device_bf16():
     # bf16 operands through the fused kernel; f32 softmax state keeps
     # the error at bf16 resolution.
@@ -61,6 +63,7 @@ def test_fused_ring_two_device_bf16():
                                rtol=0.05, atol=0.05)
 
 
+@pytest.mark.slow
 def test_fused_ring_grad_matches_dense():
     # The custom VJP routes the backward through the scan-ring rotation
     # pass; end-to-end gradients must match the dense reference.

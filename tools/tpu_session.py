@@ -61,6 +61,16 @@ def _fresh(path: str, started: float) -> bool:
         return False
 
 
+def _hard_exit(code: int) -> None:
+    """os._exit, not sys.exit: the site customization imports jax into
+    this interpreter, and its non-daemon background threads can deadlock
+    normal shutdown — a wedged poller then HOLDS the single-client
+    tunnel slot and starves every later probe (observed in round 4:
+    9.5h futex-wedged, every bench probe timing out)."""
+    sys.stderr.flush()
+    os._exit(code)
+
+
 def main() -> None:
     force = "--force" in sys.argv
     started = time.time()
@@ -73,7 +83,7 @@ def main() -> None:
             break
         if time.monotonic() > deadline:
             log("poll budget exhausted; tunnel never came up")
-            sys.exit(3)
+            _hard_exit(3)
         log(f"tunnel down (attempt {attempt}); retrying in "
             f"{POLL_INTERVAL_S:.0f}s")
         time.sleep(POLL_INTERVAL_S)
@@ -98,8 +108,8 @@ def main() -> None:
             # cheaply before burning the next stage's timeout
             if not probe():
                 log("tunnel gone; stopping the session")
-                sys.exit(4)
-    sys.exit(0 if failures == 0 else 1)
+                _hard_exit(4)
+    _hard_exit(0 if failures == 0 else 1)
 
 
 if __name__ == "__main__":
