@@ -32,4 +32,5 @@ from .formatter import Formatter  # noqa
 from .logging import ResultLogger, LogProgressBar, bold, setup_logging  # noqa
 from .solver import BaseSolver  # noqa
 from .utils import averager  # noqa
+from .ema import EMA, ema_update  # noqa
 from .xp import get_xp, main  # noqa

@@ -1,0 +1,88 @@
+# Parameter EMA — a beyond-parity solver utility (the reference's
+# averager, flashy/utils.py:19-37, averages scalar METRICS only; an
+# exponential moving average of the PARAMETERS is the standard recipe
+# for eval/serving weights in GAN, diffusion, and self-supervised
+# training, and on TPU it must live inside the jitted step: a
+# host-side EMA would stream every parameter byte over the host link
+# each step — the exact anti-pattern docs/PERF.md measures at
+# 0.02 GiB/s through the tunnel).
+#
+# Design: `ema_update` is the pure functional step (jit/pjit-safe; the
+# tree stays device-resident and inherits the params' shardings, so
+# under FSDP the shadow costs 1/N HBM per chip and ZERO collectives —
+# the update is elementwise on co-sharded leaves). `EMA` wraps it in
+# the solver's stateful protocol (state_dict/load_state_dict) so
+# `register_stateful("ema")` checkpoints and restores it like any
+# other state.
+"""Exponential moving average of parameters, TPU-resident."""
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+
+def ema_update(shadow: tp.Any, params: tp.Any, decay: float = 0.999,
+               step: tp.Optional[jax.Array] = None) -> tp.Any:
+    """One EMA fold: shadow <- decay * shadow + (1 - decay) * params.
+
+    Pure and jittable — call INSIDE the train step so the shadow never
+    leaves the device. With `step` (the optimizer step count, a traced
+    scalar), the effective decay warms up as
+    ``min(decay, (1 + step) / (10 + step))`` — the standard correction
+    that keeps early EMA from being dominated by the random init.
+    """
+    if step is not None:
+        step = jnp.asarray(step, jnp.float32)
+        d = jnp.minimum(jnp.float32(decay), (1.0 + step) / (10.0 + step))
+    else:
+        d = jnp.float32(decay)
+    return jax.tree_util.tree_map(
+        lambda s, p: (s.astype(jnp.float32) * d
+                      + p.astype(jnp.float32) * (1.0 - d)).astype(s.dtype),
+        shadow, params)
+
+
+class EMA:
+    """Stateful wrapper: solver-checkpointable parameter EMA.
+
+    Usage inside a solver::
+
+        self.ema = EMA(params, decay=0.999)
+        self.register_stateful("ema")
+        ...
+        # inside the jitted train step, thread the shadow through:
+        new_shadow = ema_update(shadow, new_params, self.ema.decay, step)
+        ...
+        self.ema.shadow = new_shadow   # rebind after the step returns
+
+    The shadow tree starts as a (device-resident) copy of `params` in
+    f32 by default — EMA in bf16 loses the small per-step increments
+    ((1-decay) * update is below bf16 resolution once decay > 0.995).
+    """
+
+    def __init__(self, params: tp.Any, decay: float = 0.999,
+                 dtype: tp.Any = jnp.float32):
+        self.decay = float(decay)
+        self.shadow = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, dtype), params)
+
+    def update(self, params: tp.Any,
+               step: tp.Optional[jax.Array] = None) -> tp.Any:
+        """Fold `params` in (outside-jit convenience) and return shadow."""
+        self.shadow = ema_update(self.shadow, params, self.decay, step)
+        return self.shadow
+
+    def state_dict(self) -> tp.Dict[str, tp.Any]:
+        return {"decay": self.decay, "shadow": self.shadow}
+
+    def load_state_dict(self, state: tp.Dict[str, tp.Any]) -> None:
+        self.decay = float(state["decay"])
+        # restore onto the live shadow's shardings/dtypes when shapes
+        # match (checkpoint may come back as host numpy arrays)
+        restored = state["shadow"]
+        live = jax.tree_util.tree_leaves(self.shadow)
+        flat, treedef = jax.tree_util.tree_flatten(restored)
+        if live and len(live) == len(flat):
+            flat = [jnp.asarray(r).astype(l.dtype) if hasattr(l, "dtype")
+                    else r for r, l in zip(flat, live)]
+        self.shadow = jax.tree_util.tree_unflatten(treedef, flat)

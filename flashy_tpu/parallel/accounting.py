@@ -17,14 +17,26 @@
 import re
 import typing as tp
 
-COLLECTIVE_OPS = ("all-gather", "all-reduce", "reduce-scatter",
-                  "collective-permute", "all-to-all", "collective-broadcast")
+# ragged-all-to-all FIRST: the alternation must not let a plain
+# "all-to-all" pattern skip it (it can't match mid-word because of the
+# preceding \s+, but listing it keeps the op attributed to its own key).
+COLLECTIVE_OPS = ("ragged-all-to-all", "all-gather", "all-reduce",
+                  "reduce-scatter", "collective-permute", "all-to-all",
+                  "collective-broadcast")
 
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
-    "c64": 8, "c128": 16,
+# Per-element BITS (sub-byte int4/int2 and fp8 payloads must not round
+# to zero — the quantize module makes them reachable).
+_DTYPE_BITS = {
+    "pred": 8, "s2": 2, "u2": 2, "s4": 4, "u4": 4, "s8": 8, "u8": 8,
+    "f8e5m2": 8, "f8e4m3": 8, "f8e4m3fn": 8, "f8e4m3b11fnuz": 8,
+    "f8e5m2fnuz": 8, "f8e4m3fnuz": 8, "f8e3m4": 8, "f8e8m0fnu": 8,
+    "f4e2m1fn": 4,
+    "s16": 16, "u16": 16, "f16": 16, "bf16": 16,
+    "s32": 32, "u32": 32, "f32": 32, "tf32": 32,
+    "s64": 64, "u64": 64, "f64": 64, "c64": 64, "c128": 128,
 }
+# shapes that legitimately carry no payload bytes
+_PAYLOADLESS = {"token", "opaque"}
 
 # `%name = <shape-or-tuple> <op>(operands...)`; `-start` covers async
 # pairs (count the start, not the matching -done, to avoid doubling).
@@ -34,23 +46,33 @@ _DTYPE_BYTES = {
 _INSTR_RE = re.compile(
     r"=\s+(?P<shape>.*?)\s+(?P<op>%s)(?:-start)?\("
     % "|".join(COLLECTIVE_OPS))
-_SHAPE_RE = re.compile(r"(?P<dtype>[a-z]+\d*)\[(?P<dims>[\d,]*)\]")
+# dtype tokens interleave letters and digits (bf16, f8e4m3fn, c128)
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>[\d,]*)\]")
 
 
 def _shape_bytes(shape_text: str) -> int:
-    """Total bytes of a shape string, summing tuple elements."""
-    total = 0
+    """Total bytes of a shape string, summing tuple elements.
+
+    Unknown dtypes raise: silently counting a payload as 0 bytes is the
+    exact silent-regression class this module exists to catch.
+    """
+    total_bits = 0
     for m in _SHAPE_RE.finditer(shape_text):
-        itemsize = _DTYPE_BYTES.get(m.group("dtype"))
-        if itemsize is None:
-            continue  # token[] / opaque shapes carry no payload
+        dtype = m.group("dtype")
+        if dtype in _PAYLOADLESS:
+            continue
+        bits = _DTYPE_BITS.get(dtype)
+        if bits is None:
+            raise ValueError(
+                f"collective accounting: unknown HLO dtype {dtype!r} in "
+                f"shape {shape_text!r}; add it to accounting._DTYPE_BITS")
         n = 1
         dims = m.group("dims")
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * itemsize
-    return total
+        total_bits += n * bits
+    return total_bits // 8
 
 
 def collective_stats(compiled: tp.Any) -> tp.Dict[str, tp.Dict[str, int]]:

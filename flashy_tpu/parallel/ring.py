@@ -355,6 +355,7 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             tuple(use_batch_axes))
     spec = P(tuple(use_batch_axes) if use_batch_axes else None, axis, None, None)
     if (impl == "fused" and jax.default_backend() == "cpu"
+            and mesh.devices.size > 1
             and mesh.devices.size >= len(jax.devices())):
         # Interpret-mode deadlock guard: on the CPU backend the fused
         # kernel's simulated RDMA semaphore waits each occupy a slot of

@@ -387,7 +387,8 @@ def _fused_fwd_impl(q, k, v, axis_name, causal, mesh_axes):
         # through `ring_self_attention` are transparently re-routed to
         # impl='scan' before reaching this point.
         mesh_size = math.prod(size for _, size in mesh_axes)
-        if mesh_size >= len(jax.devices()):
+        # size-1 meshes have no cross-device RDMA to starve on
+        if mesh_size > 1 and mesh_size >= len(jax.devices()):
             raise RuntimeError(
                 f"fused ring attention in interpret mode (CPU backend) "
                 f"over a {mesh_size}-device mesh covering every host "

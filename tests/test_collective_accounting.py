@@ -181,10 +181,21 @@ def test_hlo_parser_handles_tuples_async_and_comments():
         "%gte = f32[64]{0} get-tuple-element(%all-reduce.24), index=0",
         # bf16 permute
         "%cp = bf16[4,32]{1,0} collective-permute(%y), channel_id=3",
+        # sub-byte + fp8 payloads must not round to zero bytes
+        "%q = u4[128]{0} all-gather(%z), channel_id=4",
+        "%f8 = f8e4m3fn[64]{0} all-reduce(%w), channel_id=5",
+        # ragged MoE dispatch gets its own key, not silence
+        "%rag = f32[8,16]{1,0} ragged-all-to-all(%a, %b), channel_id=9",
     ])
     stats = collective_stats(text)
-    assert stats["all-reduce"] == {"count": 1, "bytes": 64 * 4 * 2 + 4}
-    assert stats["all-gather"] == {"count": 1,
-                                   "bytes": (8 * 16 + 64 * 16) * 4}
+    assert stats["all-reduce"] == {"count": 2,
+                                   "bytes": 64 * 4 * 2 + 4 + 64}
+    assert stats["all-gather"] == {"count": 2,
+                                   "bytes": (8 * 16 + 64 * 16) * 4 + 64}
     assert stats["collective-permute"] == {"count": 1, "bytes": 4 * 32 * 2}
+    assert stats["ragged-all-to-all"] == {"count": 1, "bytes": 8 * 16 * 4}
     assert stats["all-to-all"]["count"] == 0
+
+    # unknown dtypes are LOUD, not silently zero
+    with pytest.raises(ValueError, match="unknown HLO dtype"):
+        collective_stats("%x = q9[64]{0} all-reduce(%a), channel_id=1")

@@ -142,6 +142,7 @@ def test_moe_prefill_expert_stream_path():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(tokens))
 
 
+@pytest.mark.slow
 def test_generate_jitted_with_sharded_params():
     # sharded inference: TP/FSDP-sharded params through the jitted
     # KV-cache decoder. Greedy token chains can legitimately diverge at

@@ -306,7 +306,8 @@ class TestChunkedCrossEntropy:
         return model, params, tokens
 
     @pytest.mark.parametrize(
-        "chunk", [pytest.param(32, marks=pytest.mark.slow), 37, 200])
+        "chunk", [pytest.param(32, marks=pytest.mark.slow),
+                  pytest.param(37, marks=pytest.mark.slow), 200])
     def test_matches_dense_loss_and_grads(self, chunk):
         # chunk=37 does not divide T-1=95 (internal padding path);
         # chunk=200 exceeds T (single padded chunk).

@@ -75,7 +75,7 @@ def test_quantized_decode_tracks_full_precision(scan_layers, moe):
 @pytest.mark.parametrize("scan_layers,moe", [
     (False, 0),
     pytest.param(True, 0, marks=pytest.mark.slow),
-    (False, 2)])
+    pytest.param(False, 2, marks=pytest.mark.slow)])
 def test_quantized_generate_runs_all_layouts(scan_layers, moe):
     cfg, model, params, tokens = _model(scan_layers, moe)
     qp = quantize_lm_params(params)

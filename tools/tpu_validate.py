@@ -168,6 +168,57 @@ def validate_ring(jax, results: dict) -> bool:
     return ok
 
 
+def validate_fused_ring(jax, results: dict) -> bool:
+    """fused_ring_attention on a 1-device mesh: the ring is degenerate
+    (n_steps=1, no remote hop), but the FULL Mosaic lowering of the
+    single-kernel forward — HBM slot buffers as ANY-space outputs, DMA
+    semaphores, the per-slot REGULAR semaphore fan-out, online softmax
+    scratch — runs on real silicon for the first time (everything else
+    only ever exercised it through the interpret machinery). Oracle:
+    XLA dense attention, forward AND backward (the custom VJP routes
+    through the scan-ring rotation pass)."""
+    import jax.numpy as jnp
+    from flashy_tpu.ops import attention as attn
+    from flashy_tpu.parallel.ring import ring_self_attention
+    from flashy_tpu.utils import device_sync
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(5)
+    b, t, h, d = 2, 512, 4, 64
+    q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.bfloat16)
+               for _ in range(3))
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
+                ("data", "fsdp", "seq"))
+
+    legs = {}
+    ok = True
+    for causal in (False, True):
+        def loss_fused(q, k, v, causal=causal):
+            out = ring_self_attention(q, k, v, mesh=mesh, causal=causal,
+                                      impl="fused")
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        def loss_dense(q, k, v, causal=causal):
+            out = attn.dot_product_attention(q, k, v, causal=causal)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        f_grads = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))(q, k, v)
+        d_grads = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+        device_sync((f_grads, d_grads))
+        grad_errs = [_maxerr(fg, dg) for fg, dg in zip(f_grads, d_grads)]
+        grad_scale = max(float(np.max(np.abs(np.asarray(g))))
+                         for g in d_grads) or 1.0
+        rel = max(grad_errs) / grad_scale
+        passed = bool(rel < 3e-2)
+        legs[f"causal={causal}"] = {"grad_rel_err": round(rel, 5),
+                                    "passed": passed}
+        ok &= passed
+        log(f"fused_ring/causal={causal}: grad_rel={rel:.2e} "
+            f"{'OK' if passed else 'FAIL'}")
+    results["fused_ring_parity"] = legs
+    return ok
+
+
 def validate_gmm(jax, results: dict) -> bool:
     import jax.numpy as jnp
     from flashy_tpu.parallel.moe_ep import _grouped_mlp
@@ -228,6 +279,7 @@ def run_tuner(jax, results: dict) -> None:
 
 
 def main() -> None:
+    global OUT_PATH
     import jax
     from flashy_tpu.utils import pin_platform
     pin_platform()
@@ -236,9 +288,20 @@ def main() -> None:
                "device_kind": jax.devices()[0].device_kind,
                "interpret_mode": platform == "cpu"}
     log(f"backend: {platform} / {results['device_kind']}")
+    if platform != "tpu":
+        # never clobber an on-chip capture with an interpret-mode smoke
+        try:
+            with open(OUT_PATH) as f:
+                if json.load(f).get("platform") == "tpu":
+                    OUT_PATH = OUT_PATH.replace(".json", f".{platform}.json")
+                    log(f"existing artifact is an on-chip capture; "
+                        f"writing to {OUT_PATH} instead")
+        except (OSError, ValueError):
+            pass
 
     ok = True
     for name, fn in (("flash", validate_flash), ("ring", validate_ring),
+                     ("fused_ring", validate_fused_ring),
                      ("gmm", validate_gmm)):
         try:
             ok &= fn(jax, results)
