@@ -15,7 +15,7 @@ import optax
 import flashy_tpu
 from flashy_tpu import distrib
 from flashy_tpu.data import prefetch_to_device
-from flashy_tpu.models import resnet18, resnet50
+from flashy_tpu.models import resnet18, resnet50, vit_tiny
 from flashy_tpu.parallel import make_mesh, wrap
 from flashy_tpu.utils import device_sync
 
@@ -26,7 +26,8 @@ class Solver(flashy_tpu.BaseSolver):
         self.cfg = cfg
         self.loaders = loaders
         self.is_real = is_real
-        model_fn = {"resnet18": resnet18, "resnet50": resnet50}[cfg.model]
+        model_fn = {"resnet18": resnet18, "resnet50": resnet50,
+                    "vit_tiny": vit_tiny}[cfg.model]
         self.model = model_fn(num_classes=10)
 
         n_data = cfg.data_parallel if cfg.data_parallel > 0 else len(jax.devices())
@@ -45,9 +46,11 @@ class Solver(flashy_tpu.BaseSolver):
         self.optim = optax.chain(
             optax.add_decayed_weights(cfg.weight_decay),
             optax.sgd(schedule, momentum=cfg.momentum, nesterov=True))
+        # ViT has no BatchNorm: batch_stats is an empty dict then, and
+        # the shared step functions thread it through untouched.
         self.state = {
             "params": variables["params"],
-            "batch_stats": variables["batch_stats"],
+            "batch_stats": variables.get("batch_stats", {}),
             "opt_state": self.optim.init(variables["params"]),
         }
         self.register_stateful("state")
@@ -59,13 +62,22 @@ class Solver(flashy_tpu.BaseSolver):
         model, optim = self.model, self.optim
 
         def step(state, batch):
+            has_bn = bool(state["batch_stats"])
+
             def loss_fn(params):
-                logits, mutated = model.apply(
-                    {"params": params, "batch_stats": state["batch_stats"]},
-                    batch["image"], train=True, mutable=["batch_stats"])
+                if has_bn:
+                    logits, mutated = model.apply(
+                        {"params": params,
+                         "batch_stats": state["batch_stats"]},
+                        batch["image"], train=True, mutable=["batch_stats"])
+                    stats = mutated["batch_stats"]
+                else:
+                    logits = model.apply({"params": params}, batch["image"],
+                                         train=True)
+                    stats = state["batch_stats"]
                 loss = optax.softmax_cross_entropy_with_integer_labels(
                     logits, batch["label"]).mean()
-                return loss, (logits, mutated["batch_stats"])
+                return loss, (logits, stats)
 
             (loss, (logits, batch_stats)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state["params"])
@@ -88,9 +100,10 @@ class Solver(flashy_tpu.BaseSolver):
             # the host can weight by the true valid count — padding rows
             # contribute nothing and sharded eval equals unsharded eval
             # exactly.
-            logits = model.apply(
-                {"params": state["params"], "batch_stats": state["batch_stats"]},
-                batch["image"], train=False)
+            variables = {"params": state["params"]}
+            if state["batch_stats"]:
+                variables["batch_stats"] = state["batch_stats"]
+            logits = model.apply(variables, batch["image"], train=False)
             valid = batch["valid"]
             loss_vec = optax.softmax_cross_entropy_with_integer_labels(
                 logits, batch["label"])

@@ -6,7 +6,8 @@ from .mlp import MLP
 from .moe import MoEMLP, moe_aux_loss
 from .resnet import ResNet, resnet18, resnet34, resnet50
 from .transformer import TransformerLM, TransformerConfig, transformer_shardings
-from .decoding import generate, init_cache
+from .vit import ViT, ViTConfig, vit_tiny, vit_small
+from .decoding import generate, init_cache, nucleus_filter
 from .quantize import (quantize_lm_params, dequantize_lm_params,
                        is_quantized)
 from .pipelined import pipelined_apply

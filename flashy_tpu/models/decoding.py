@@ -255,8 +255,30 @@ def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
     return logits, new_cache
 
 
+def nucleus_filter(logits: jax.Array, top_p: float) -> jax.Array:
+    """Top-p (nucleus) logit filter, sort-once formulation.
+
+    A token stays eligible iff the cumulative probability of STRICTLY
+    more likely tokens is < `top_p` — so the argmax always survives,
+    even when its own probability exceeds `top_p`. Tokens exactly TIED
+    with the cutoff logit all stay eligible (dropping an arbitrary
+    subset of equally-likely tokens would bias the distribution).
+    Ineligible logits are masked to -1e30. Jit-safe (one sort + cumsum,
+    no dynamic shapes); `logits` is [..., vocab].
+    """
+    sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    eligible = cum_before < top_p
+    # cutoff = the smallest sorted logit still eligible per row
+    cutoff = jnp.min(jnp.where(eligible, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits < cutoff, -1e30, logits)
+
+
 def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: tp.Optional[int] = None,
+             top_p: tp.Optional[float] = None,
              rng: tp.Optional[jax.Array] = None) -> jax.Array:
     """Autoregressive generation with a KV cache.
 
@@ -269,12 +291,23 @@ def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
         max_new_tokens: tokens to append.
         temperature: 0 -> greedy; >0 -> sampling.
         top_k: restrict sampling to the k most likely tokens.
+        top_p: nucleus sampling — restrict to the smallest set of
+            tokens whose cumulative probability reaches `top_p` (the
+            most likely token always stays eligible). Composes with
+            top_k (applied first).
         rng: PRNG key (required when temperature > 0).
 
     Returns [B, P + max_new_tokens] tokens. Jit-compatible: shapes are
     static in P and max_new_tokens.
     """
     cfg: TransformerConfig = model.config
+    if not getattr(cfg, "causal", True):
+        # the KV-cache mask below is causal by construction; decoding a
+        # bidirectional encoder would silently diverge from model.apply
+        raise ValueError(
+            "generate() implements causal KV-cache decoding; a "
+            "config.causal=False (bidirectional/encoder) model has no "
+            "autoregressive decode.")
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
@@ -298,6 +331,8 @@ def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
         if top_k is not None:
             kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
+        if top_p is not None:
+            logits = nucleus_filter(logits, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     def step(carry, t):

@@ -39,6 +39,7 @@ class TransformerConfig:
                                  # train=True (pass rngs={'dropout': key})
     dtype: tp.Any = jnp.bfloat16
     attention: str = "flash"     # 'flash' | 'dense' | 'ring' | 'ring_fused'
+    causal: bool = True          # False = bidirectional (encoder/ViT)
     remat: bool = False          # jax.checkpoint each block (HBM for FLOPs)
     remat_policy: str = "full"   # what remat SAVES per block:
                                  #   'full'  - nothing (recompute all);
@@ -102,12 +103,12 @@ class Attention(nn.Module):
         if cfg.attention in ("ring", "ring_fused"):
             from ..parallel import ring_self_attention
             out = ring_self_attention(
-                q, k, v, mesh=self.mesh, causal=True,
+                q, k, v, mesh=self.mesh, causal=cfg.causal,
                 impl="fused" if cfg.attention == "ring_fused" else "scan")
         elif cfg.attention == "flash":
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=cfg.causal)
         else:
-            out = dot_product_attention(q, k, v, causal=True)
+            out = dot_product_attention(q, k, v, causal=cfg.causal)
 
         out = nn.DenseGeneral(cfg.dim, axis=(-2, -1), use_bias=False,
                               dtype=cfg.dtype, name="out")(out)

@@ -1,0 +1,110 @@
+# Vision Transformer classifier — a second model family on the shared
+# transformer stack (the reference ships no models at all; SURVEY §2.2's
+# cifar example is its only vision workload). TPU-first choices:
+#
+#  * patchify as a single strided Conv: one big matmul-shaped op the
+#    MXU eats whole, instead of a reshape/gather patch extraction;
+#  * the encoder reuses `transformer.Block` with `causal=False` —
+#    identical kernels (fused QKV, flash attention, SwiGLU MLP) and the
+#    same sharding story as the LM, so TP/FSDP specs transfer;
+#  * rotary position encoding over the flattened patch index (no
+#    learned positional table to resize when the image size changes);
+#  * mean-pool head, no CLS token: keeps the sequence length exactly
+#    (image/patch)^2 — a power of two for the usual sizes, so flash
+#    attention tiles stay 128-aligned (a CLS token's +1 would force the
+#    unaligned tail path everywhere).
+"""ViT image classifier built on the shared transformer blocks."""
+import dataclasses
+import typing as tp
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .transformer import Block, TransformerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    num_classes: int = 10
+    dim: int = 192
+    num_layers: int = 6
+    num_heads: int = 3
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: tp.Any = jnp.bfloat16
+    attention: str = "dense"     # 'flash' needs >=128 patches to tile
+    remat: bool = False
+    remat_policy: str = "full"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def block_config(self) -> TransformerConfig:
+        """The shared-block config: bidirectional, no vocab."""
+        return TransformerConfig(
+            vocab_size=1, dim=self.dim, num_layers=self.num_layers,
+            num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+            max_seq_len=self.num_patches, dropout=self.dropout,
+            dtype=self.dtype, attention=self.attention, causal=False,
+            remat=self.remat, remat_policy=self.remat_policy)
+
+
+class ViT(nn.Module):
+    """images [B, H, W, C] float -> logits [B, num_classes]."""
+
+    config: ViTConfig
+    mesh: tp.Any = None
+
+    @nn.compact
+    def __call__(self, images: jax.Array, train: bool = False) -> jax.Array:
+        cfg = self.config
+        if images.shape[1] != cfg.image_size or images.shape[2] != cfg.image_size:
+            raise ValueError(
+                f"image shape {images.shape[1]}x{images.shape[2]} != "
+                f"config.image_size={cfg.image_size} (square input): the "
+                f"patch grid would contradict num_patches")
+        p = cfg.patch_size
+        # Patchify: strided conv == per-patch linear projection, batched
+        # into one MXU-friendly contraction.
+        x = nn.Conv(cfg.dim, kernel_size=(p, p), strides=(p, p),
+                    padding="VALID", use_bias=True, dtype=cfg.dtype,
+                    name="patch")(images.astype(cfg.dtype))
+        batch = x.shape[0]
+        x = x.reshape(batch, -1, cfg.dim)  # [B, N, D]
+
+        bcfg = cfg.block_config()
+        positions = jnp.broadcast_to(
+            jnp.arange(x.shape[1], dtype=jnp.int32)[None, :],
+            (batch, x.shape[1]))
+        block = Block
+        if cfg.remat:
+            from .transformer import _remat
+            block = _remat(bcfg)
+        for layer in range(cfg.num_layers):
+            x = block(bcfg, mesh=self.mesh, name=f"block_{layer}")(
+                x, positions, train)
+        x = nn.RMSNorm(dtype=cfg.dtype, name="norm")(x)
+        pooled = x.mean(axis=1)  # [B, D]
+        # classifier head in f32: logits feed the softmax directly
+        return nn.Dense(cfg.num_classes, use_bias=True, dtype=jnp.float32,
+                        name="head")(pooled.astype(jnp.float32))
+
+
+def vit_tiny(num_classes: int = 10, image_size: int = 32,
+             patch_size: int = 4, **kw) -> ViT:
+    """ViT-Ti-ish for 32x32 inputs (the cifar example scale)."""
+    return ViT(ViTConfig(image_size=image_size, patch_size=patch_size,
+                         num_classes=num_classes, dim=192, num_layers=6,
+                         num_heads=3, **kw))
+
+
+def vit_small(num_classes: int = 1000, image_size: int = 224,
+              patch_size: int = 16, **kw) -> ViT:
+    """ViT-S/16 at the standard ImageNet scale."""
+    return ViT(ViTConfig(image_size=image_size, patch_size=patch_size,
+                         num_classes=num_classes, dim=384, num_layers=12,
+                         num_heads=6, **kw))

@@ -47,6 +47,17 @@ def test_cifar_example(tmp_path):
 
 
 @pytest.mark.slow
+def test_cifar_example_vit(tmp_path):
+    # the second model family through the SAME example/solver: the
+    # BN-free state path (batch_stats == {}) must train and eval
+    _run_example(tmp_path, "examples.cifar.train", "model=vit_tiny",
+                 "epochs=1", "max_batches=2", "batch_size=16")
+    history = _history(tmp_path)
+    assert set(history[0].keys()) == {"train", "valid"}
+    assert np.isfinite(history[0]["valid"]["loss"])
+
+
+@pytest.mark.slow
 def test_lm_example(tmp_path):
     # batch must divide the data axis (8 virtual devices under the
     # test env's XLA_FLAGS, which the subprocess inherits)
@@ -170,3 +181,77 @@ def test_lm_eval_stream_disjoint_from_train():
         assert not np.array_equal(train, evalb), step
         np.testing.assert_array_equal(train, stream(4, 64, step, subset=0))
         np.testing.assert_array_equal(evalb, stream(4, 64, step, subset=1))
+
+
+def test_lm_solver_ema_shadow_tracks_params():
+    """ema_decay > 0 threads an f32 shadow through the sharded jitted
+    train step; valid() evaluates the shadow. The shadow must (a) exist
+    in the checkpointed state, (b) move toward the live params, (c) stay
+    f32 while params are whatever the model config says."""
+    import jax
+    import jax.numpy as jnp
+    from examples.lm.solver import LMSolver
+    from flashy_tpu.xp import Config, temporary_xp
+
+    cfg = Config({
+        "model": {"vocab_size": 64, "dim": 32, "num_layers": 1,
+                  "num_heads": 2, "mlp_ratio": 2, "attention": "dense"},
+        "mesh": {"data": 8}, "seq_len": 16, "batch_size": 8,
+        "accumulate": 1, "steps_per_epoch": 2, "epochs": 1,
+        "generate_every": 0, "lr": 1e-2, "warmup_steps": 1,
+        "weight_decay": 0.0, "ema_decay": 0.9,
+    })
+    with temporary_xp():
+        solver = LMSolver(cfg)
+        assert "ema" in solver.state
+        before_leaf = jax.tree_util.tree_leaves(solver.state["ema"])[0]
+        assert before_leaf.dtype == jnp.float32
+        # the train step donates its input state: snapshot to host first
+        before = np.asarray(jax.device_get(before_leaf), np.float64)
+        state, _ = solver._train_step(solver.state, solver.batch_at(0))
+        # shadow moved toward the updated params
+        p = jax.tree_util.tree_leaves(state["params"])[0]
+        e = jax.tree_util.tree_leaves(state["ema"])[0]
+        assert e.dtype == jnp.float32
+        # warmup decay at step 0 is 1/10: shadow is 90% of the way to p
+        np.testing.assert_allclose(
+            np.asarray(e, np.float64),
+            before * 0.1 + np.asarray(p, np.float64) * 0.9,
+            rtol=2e-3, atol=2e-6)
+
+
+def test_lm_solver_ema_reconcile_after_restore():
+    """restore() replaces the state wholesale; the solver must align the
+    restored contents with THIS run's ema_decay (a pre-EMA checkpoint
+    resumed with EMA on gets a fresh shadow; a shadow resumed with EMA
+    off is dropped)."""
+    import jax
+    import jax.numpy as jnp
+    from examples.lm.solver import LMSolver
+    from flashy_tpu.xp import Config, temporary_xp
+
+    def make(decay):
+        return Config({
+            "model": {"vocab_size": 64, "dim": 32, "num_layers": 1,
+                      "num_heads": 2, "mlp_ratio": 2, "attention": "dense"},
+            "mesh": {"data": 8}, "seq_len": 16, "batch_size": 8,
+            "accumulate": 1, "steps_per_epoch": 1, "epochs": 1,
+            "generate_every": 0, "lr": 1e-2, "warmup_steps": 1,
+            "weight_decay": 0.0, "ema_decay": decay,
+        })
+
+    with temporary_xp():
+        solver = LMSolver(make(0.9))
+        # simulate restoring a pre-EMA checkpoint
+        del solver.state["ema"]
+        solver._reconcile_ema()
+        assert "ema" in solver.state
+        leaf = jax.tree_util.tree_leaves(solver.state["ema"])[0]
+        assert leaf.dtype == jnp.float32
+
+    with temporary_xp():
+        solver = LMSolver(make(0.0))
+        # simulate restoring a checkpoint that carried a shadow
+        solver.state["ema"] = solver.state["params"]
+        solver._reconcile_ema()
+        assert "ema" not in solver.state

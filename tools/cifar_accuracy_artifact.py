@@ -29,6 +29,29 @@ def main() -> None:
              for i, e in enumerate(history)]
     valid_accs = [c["valid_acc"] for c in curve if c["valid_acc"] is not None]
     best = max(valid_accs) if valid_accs else None  # None = no eval yet
+
+    # Data-source label from the RUN's own record, not a config guess
+    # (data_root=null means search-then-synthetic-fallback, so the
+    # config alone cannot say what was trained on): the solver logs
+    # "CIFAR-10 data: real|synthetic" at startup.
+    import glob
+    data = "unknown"
+    for log_path in sorted(glob.glob(os.path.join(args.xp_folder,
+                                                  "solver.log.*"))):
+        with open(log_path, errors="replace") as f:
+            for line in f:
+                if "CIFAR-10 data:" in line:
+                    data = line.rsplit("CIFAR-10 data:", 1)[1].strip()
+                    break
+        if data != "unknown":
+            break
+
+    note = ("budgeted run of examples/cifar exactly as a user launches it "
+            "(python -m examples.cifar.train epochs=... max_batches=...)")
+    if data == "synthetic":
+        note += ("; synthetic stand-in dataset (zero-egress host) — "
+                 "examples/cifar/data.py designs it so >0.9 valid accuracy "
+                 "indicates a working training recipe")
     record = {
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "model": config.get("model"),
@@ -37,13 +60,8 @@ def main() -> None:
         "batch_size": config.get("batch_size"),
         "max_batches": config.get("max_batches"),
         "lr": config.get("lr"),
-        "data": "synthetic" if config.get("data_root") in (None, "null")
-                else "real",
-        "note": ("budgeted CPU run of examples/cifar exactly as a user "
-                 "launches it (python -m examples.cifar.train epochs=... "
-                 "max_batches=...); synthetic stand-in dataset (zero-egress "
-                 "host) — examples/cifar/data.py designs it so >0.9 valid "
-                 "accuracy indicates a working training recipe"),
+        "data": data,
+        "note": note,
         "best_valid_acc": best,
         "curve": curve,
     }
