@@ -255,3 +255,72 @@ def test_lm_solver_ema_reconcile_after_restore():
         solver.state["ema"] = solver.state["params"]
         solver._reconcile_ema()
         assert "ema" not in solver.state
+
+
+@pytest.mark.slow
+def test_mlm_example(tmp_path):
+    # the bidirectional encoder workload end-to-end (causal=False
+    # through the shared blocks, masked-CE objective, solver surface)
+    _run_example(tmp_path, "examples.mlm.solver", "epochs=1",
+                 "steps_per_epoch=2", "valid_steps=1", "batch_size=8",
+                 "seq_len=32", "model.dim=32", "model.num_layers=1",
+                 "model.num_heads=2", "model.vocab_size=64",
+                 "model.attention=dense", "warmup_steps=1")
+    history = _history(tmp_path)
+    assert "ppl" in history[0]["train"]
+    assert np.isfinite(history[0]["valid"]["loss"])
+
+
+def test_mlm_masking_recipe_invariants():
+    """batch_at implements the 80/10/10 BERT recipe: ~mask_prob of
+    positions selected; of those ~80% become [MASK], ~10% random, ~10%
+    unchanged; labels always hold the ORIGINAL token; the [MASK] id
+    never occurs naturally in the labels."""
+    import jax
+    from examples.mlm.solver import MLMSolver
+    from flashy_tpu.xp import Config, temporary_xp
+
+    cfg = Config({
+        "model": {"vocab_size": 64, "dim": 32, "num_layers": 1,
+                  "num_heads": 2, "mlp_ratio": 2, "attention": "dense"},
+        "mesh": {"data": 8}, "seq_len": 128, "batch_size": 16,
+        "mask_prob": 0.15, "mask_token": 0,
+        "epochs": 1, "steps_per_epoch": 1, "valid_steps": 0,
+        "lr": 1e-3, "warmup_steps": 1, "weight_decay": 0.0,
+    })
+    with temporary_xp():
+        solver = MLMSolver(cfg)
+        batch = {k: np.asarray(jax.device_get(v))
+                 for k, v in solver.batch_at(0).items()}
+
+    sel = batch["selected"]
+    frac = sel.mean()
+    assert 0.10 < frac < 0.20, frac
+    # the reserved id never appears among the labels or random swaps
+    assert (batch["labels"] != 0).all()
+    # unselected inputs are untouched
+    np.testing.assert_array_equal(batch["inputs"][~sel],
+                                  batch["labels"][~sel])
+    masked = (batch["inputs"] == 0) & sel
+    changed = (batch["inputs"] != batch["labels"]) & sel & ~masked
+    kept = (batch["inputs"] == batch["labels"]) & sel
+    n = sel.sum()
+    assert 0.7 < masked.sum() / n < 0.9          # ~80% [MASK]
+    assert kept.sum() / n > 0.05                 # ~10% kept (+ random
+    assert changed.sum() / n < 0.2               #  collisions land here)
+    # train and eval masks/streams differ at the same step (batch_at
+    # is stateless — same solver serves both subsets), and a NON-ZERO
+    # mask_token is reserved just the same (the id never occurs in
+    # labels; 80% of selected inputs carry it)
+    with temporary_xp():
+        solver = MLMSolver(cfg)
+        ev = {k: np.asarray(jax.device_get(v))
+              for k, v in solver.batch_at(0, eval_set=True).items()}
+        solver.cfg["mask_token"] = 5
+        b5 = {k: np.asarray(jax.device_get(v))
+              for k, v in solver.batch_at(0).items()}
+    assert not np.array_equal(ev["labels"], batch["labels"])
+    assert (b5["labels"] != 5).all()
+    sel5 = b5["selected"]
+    n5 = sel5.sum()
+    assert 0.7 < ((b5["inputs"] == 5) & sel5).sum() / n5 < 0.9
