@@ -74,6 +74,8 @@ CPU_RECOVERY_WAIT_S = float(os.environ.get("FLASHY_TPU_BENCH_CPU_WAIT", "600"))
 # alongside a real bench) don't race on the same files.
 _STATE_DIR = os.environ.get("FLASHY_TPU_BENCH_STATE_DIR",
                             os.path.dirname(os.path.abspath(__file__)))
+os.makedirs(_STATE_DIR, exist_ok=True)  # a missing dir would silently
+#                                         break every partial persist
 PARTIAL_PATH = os.path.join(_STATE_DIR, "BENCH_PARTIAL.json")
 DETAIL_PATH = os.path.join(_STATE_DIR, "BENCH_DETAIL.json")
 
@@ -486,6 +488,22 @@ def _measure_lm_config(jax, overrides, batch, seq, dims, warmup, measure,
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
 
+    # compile explicitly so the variant's per-device memory footprint
+    # lands in the record (the OOM boundary between remat/no-remat/
+    # chunked-CE configs is part of the perf story — docs/TPU_NOTES.md)
+    mem = {}
+    try:
+        compiled = step.lower(state, tokens).compile()
+        step = compiled  # keep the AOT executable even if stats fail
+    except Exception as exc:  # noqa: BLE001 — fall back to lazy jit
+        log(f"lm: explicit compile unavailable ({exc})")
+    else:
+        try:
+            from flashy_tpu.parallel import memory_stats
+            mem = memory_stats(compiled)
+        except Exception as exc:  # noqa: BLE001 — stats are optional
+            log(f"lm: memory accounting unavailable ({exc})")
+
     for _ in range(warmup):
         state, loss = step(state, tokens)
     device_sync(loss)
@@ -516,10 +534,13 @@ def _measure_lm_config(jax, overrides, batch, seq, dims, warmup, measure,
         f"{achieved / 1e12:.1f} TFLOP/s/chip, MFU={mfu} "
         f"(vs measured peak: {mfu_measured}) "
         f"({n_params / 1e6:.0f}M params, seq {seq}, batch {batch})")
-    return {"tokens_per_sec_per_chip": round(tokens_per_sec_per_chip, 1),
-            "mfu": mfu, "mfu_vs_measured": mfu_measured,
-            "achieved_tflops_per_chip": round(achieved / 1e12, 2),
-            "n_params": n_params, "seq_len": seq, "batch_size": batch}
+    result = {"tokens_per_sec_per_chip": round(tokens_per_sec_per_chip, 1),
+              "mfu": mfu, "mfu_vs_measured": mfu_measured,
+              "achieved_tflops_per_chip": round(achieved / 1e12, 2),
+              "n_params": n_params, "seq_len": seq, "batch_size": batch}
+    if mem.get("peak"):
+        result["hbm_peak_gib"] = round(mem["peak"] / 2**30, 3)
+    return result
 
 
 # The r3 benched default: flash+remat at b=16 — kept as the published

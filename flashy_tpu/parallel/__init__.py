@@ -11,4 +11,4 @@ from .ring import ring_attention, ring_self_attention
 from .ring_fused import fused_ring_attention
 from .pipeline import pipeline
 from .moe_ep import ep_dropless_moe
-from .accounting import collective_stats, total_collective_bytes
+from .accounting import collective_stats, memory_stats, total_collective_bytes

@@ -100,3 +100,29 @@ def collective_stats(compiled: tp.Any) -> tp.Dict[str, tp.Dict[str, int]]:
 def total_collective_bytes(compiled: tp.Any) -> int:
     """Sum of `collective_stats` bytes over every collective kind."""
     return sum(e["bytes"] for e in collective_stats(compiled).values())
+
+
+def memory_stats(compiled: tp.Any) -> tp.Dict[str, int]:
+    """Per-device memory footprint of a compiled step, in bytes.
+
+    The compile-time companion of `collective_stats`: HBM admission can
+    be checked BEFORE touching hardware (a remat-policy or batch-size
+    change that would OOM a 16G chip shows up here as `peak` > budget),
+    and tests can assert that e.g. FSDP actually shrinks the per-device
+    argument footprint vs replication. Keys:
+      * arguments — bytes of the (per-device shards of the) inputs
+      * outputs   — bytes of the outputs
+      * temp      — XLA temp buffer allocation (activations, scratch)
+      * aliased   — donated input bytes reused for outputs
+      * peak      — peak liveness the buffer assignment reaches
+    """
+    ma = compiled.memory_analysis()
+    if ma is None:  # some backends don't expose buffer assignment
+        return {}
+    return {
+        "arguments": int(ma.argument_size_in_bytes),
+        "outputs": int(ma.output_size_in_bytes),
+        "temp": int(ma.temp_size_in_bytes),
+        "aliased": int(ma.alias_size_in_bytes),
+        "peak": int(ma.peak_memory_in_bytes),
+    }

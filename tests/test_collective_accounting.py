@@ -18,10 +18,11 @@ from flashy_tpu.parallel import (collective_stats, make_mesh, shard_batch,
                                  total_collective_bytes)
 
 
-def _compiled_step(mesh, cfg, batch, seq, param_specs=None):
-    """jit-compile one full train step on `mesh`; returns (stats, nbytes
-    of params). `param_specs` overrides transformer_shardings (pass a
-    replicated tree to model the regression being guarded against)."""
+def _compile_train_step(mesh, cfg, batch, seq, param_specs=None):
+    """Lower+compile one full train step on `mesh`; returns
+    (compiled, param_bytes). `param_specs` overrides
+    transformer_shardings (pass a replicated tree to model the
+    regression being guarded against)."""
     model = TransformerLM(cfg, mesh=mesh)
     tokens_host = np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, seq)).astype(np.int32)
@@ -58,6 +59,13 @@ def _compiled_step(mesh, cfg, batch, seq, param_specs=None):
     compiled = jax.jit(train_step).lower(params, opt_state, tokens).compile()
     param_bytes = sum(x.size * x.dtype.itemsize
                       for x in jax.tree_util.tree_leaves(params))
+    return compiled, param_bytes
+
+
+def _compiled_step(mesh, cfg, batch, seq, param_specs=None):
+    """collective_stats of the compiled step (see _compile_train_step)."""
+    compiled, param_bytes = _compile_train_step(mesh, cfg, batch, seq,
+                                                param_specs)
     return collective_stats(compiled), param_bytes
 
 
@@ -199,3 +207,46 @@ def test_hlo_parser_handles_tuples_async_and_comments():
     # unknown dtypes are LOUD, not silently zero
     with pytest.raises(ValueError, match="unknown HLO dtype"):
         collective_stats("%x = q9[64]{0} all-reduce(%a), channel_id=1")
+
+
+@pytest.mark.slow
+def test_memory_stats_fsdp_shrinks_argument_footprint():
+    """memory_stats: FSDP-sharded params must cost a fraction of the
+    replicated argument footprint per device — an HBM-admission claim
+    checked entirely at compile time. Reuses _compile_train_step so the
+    batch-pinning fix (dispatch resharding would otherwise falsify the
+    replicated control's argument count) applies here too."""
+    from flashy_tpu.parallel import memory_stats
+
+    mesh = make_mesh({"fsdp": 4, "data": 2})
+    cfg = TransformerConfig(**_CFG)
+
+    compiled, _ = _compile_train_step(mesh, cfg, batch=16, seq=32)
+    sharded = memory_stats(compiled)
+    if not sharded:
+        pytest.skip("backend exposes no memory analysis")
+
+    model = TransformerLM(cfg, mesh=mesh)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (16, 32)), jnp.int32)
+    variables = {"params": model.init(jax.random.PRNGKey(0), tokens)["params"]}
+    replicated_specs = jax.tree_util.tree_map(lambda _: P(), variables)
+    compiled_r, _ = _compile_train_step(mesh, cfg, batch=16, seq=32,
+                                        param_specs=replicated_specs)
+    replicated = memory_stats(compiled_r)
+    # params (and their optimizer/gradient mirrors) dominate the
+    # arguments; fsdp=4 must cut them well below the replicated
+    # footprint (some leaves — norms, biases — stay replicated)
+    assert sharded["arguments"] < 0.6 * replicated["arguments"], (
+        sharded, replicated)
+    for stats in (sharded, replicated):
+        assert stats["peak"] > 0 and stats["temp"] > 0
+    # remat programs flow through the same accounting without error
+    # (the temp DIRECTION is backend-specific: the CPU scheduler can
+    # make recompute buffers outweigh the saved residuals at small
+    # sizes, so no direction is asserted here; on-chip probing lives
+    # in tools/ — see docs/PERF.md)
+    compiled_rm, _ = _compile_train_step(
+        mesh, TransformerConfig(**dict(_CFG, remat=True)), batch=16, seq=32)
+    remat = memory_stats(compiled_rm)
+    assert remat["peak"] > 0 and remat["temp"] > 0
