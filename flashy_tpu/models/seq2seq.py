@@ -1,0 +1,215 @@
+# Encoder-decoder transformer — completes the family triad (decoder-
+# only `TransformerLM`, encoder-only `ViT`/MLM, and now seq2seq). Built
+# from the SAME shared pieces so every TPU-first property carries over:
+#
+#  * encoder = the shared `transformer.Block` with `causal=False`
+#    (bidirectional flash/dense attention, SwiGLU MLP, RMSNorm);
+#  * decoder blocks add one cross-attention sublayer between the causal
+#    self-attention and the MLP — fused KV projection over the encoder
+#    memory (one [D, 2D] matmul), no positional encoding on the
+#    cross path (alignment is learned; rotary stays on self-attention
+#    where relative offsets are meaningful);
+#  * setup()-based module: `encode` and `decode` are standalone apply
+#    methods, so generation computes the encoder memory ONCE and scans
+#    only the decoder (`greedy_translate`);
+#  * the sharding rules extend `transformer_shardings` by name
+#    (megatron column/row splits over 'tensor', FSDP over 'fsdp'), so
+#    a seq2seq step shards with the same one-liner as the LM.
+"""Seq2Seq encoder-decoder transformer on the shared blocks."""
+import dataclasses
+import typing as tp
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from .transformer import Attention, Block, MLPBlock, TransformerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Seq2SeqConfig:
+    vocab_size: int = 32000
+    dim: int = 512
+    enc_layers: int = 6
+    dec_layers: int = 6
+    num_heads: int = 8
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    dropout: float = 0.0
+    dtype: tp.Any = jnp.bfloat16
+    attention: str = "dense"     # self-attention impl: 'dense' | 'flash'
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.num_heads
+
+    def _block_config(self, causal: bool) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=1, dim=self.dim, num_heads=self.num_heads,
+            mlp_ratio=self.mlp_ratio, max_seq_len=self.max_seq_len,
+            dropout=self.dropout, dtype=self.dtype,
+            attention=self.attention, causal=causal)
+
+
+class CrossAttention(nn.Module):
+    """Decoder queries attend over the encoder memory (no mask)."""
+
+    config: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, memory: jax.Array,
+                 train: bool = False) -> jax.Array:
+        cfg = self.config
+        q = nn.DenseGeneral((cfg.num_heads, cfg.head_dim), axis=-1,
+                            use_bias=False, dtype=cfg.dtype, name="q")(x)
+        kv = nn.DenseGeneral((2, cfg.num_heads, cfg.head_dim), axis=-1,
+                             use_bias=False, dtype=cfg.dtype,
+                             name="kv")(memory)
+        k, v = kv[:, :, 0], kv[:, :, 1]
+        out = dot_product_attention(q, k, v, causal=False)
+        out = nn.DenseGeneral(cfg.dim, axis=(-2, -1), use_bias=False,
+                              dtype=cfg.dtype, name="out")(out)
+        if cfg.dropout > 0.0:
+            out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
+        return out
+
+
+class DecoderBlock(nn.Module):
+    """Causal self-attention + cross-attention + MLP, pre-RMSNorm."""
+
+    config: Seq2SeqConfig
+    mesh: tp.Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, memory: jax.Array,
+                 positions: jax.Array, train: bool = False) -> jax.Array:
+        cfg = self.config
+        bcfg = cfg._block_config(causal=True)
+        x = x + Attention(bcfg, mesh=self.mesh, name="attn")(
+            nn.RMSNorm(dtype=cfg.dtype, name="norm1")(x), positions, train)
+        x = x + CrossAttention(cfg, name="xattn")(
+            nn.RMSNorm(dtype=cfg.dtype, name="norm2")(x), memory, train)
+        x = x + MLPBlock(bcfg, name="mlp")(
+            nn.RMSNorm(dtype=cfg.dtype, name="norm3")(x), train)
+        return x
+
+
+class Seq2SeqTransformer(nn.Module):
+    """(src [B, S], tgt [B, T]) int32 -> logits [B, T, vocab].
+
+    Teacher-forced training forward: the decoder sees `tgt` shifted by
+    the caller (standard convention: feed BOS + tgt[:-1], predict tgt).
+    The embedding table is shared between source, target, and the tied
+    output head. `encode` / `decode` are standalone apply methods
+    (`model.apply(params, src, method=Seq2SeqTransformer.encode)`), so
+    serving computes the memory once.
+    """
+
+    config: Seq2SeqConfig
+    mesh: tp.Any = None
+
+    def setup(self):
+        cfg = self.config
+        self.embed = self.param(
+            "embed", nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.dim), jnp.float32)
+        enc_cfg = cfg._block_config(causal=False)
+        self.enc_blocks = [Block(enc_cfg, mesh=self.mesh)
+                           for _ in range(cfg.enc_layers)]
+        self.enc_norm = nn.RMSNorm(dtype=cfg.dtype)
+        self.dec_blocks = [DecoderBlock(cfg, mesh=self.mesh)
+                           for _ in range(cfg.dec_layers)]
+        self.dec_norm = nn.RMSNorm(dtype=cfg.dtype)
+
+    def _positions(self, tokens: jax.Array) -> jax.Array:
+        if tokens.shape[1] > self.config.max_seq_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds "
+                f"max_seq_len={self.config.max_seq_len}")
+        return jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
+            tokens.shape)
+
+    def encode(self, src: jax.Array, train: bool = False) -> jax.Array:
+        positions = self._positions(src)
+        x = jnp.take(self.embed, src, axis=0).astype(self.config.dtype)
+        for block in self.enc_blocks:
+            x = block(x, positions, train)
+        return self.enc_norm(x)
+
+    def decode(self, tgt: jax.Array, memory: jax.Array,
+               train: bool = False) -> jax.Array:
+        positions = self._positions(tgt)
+        y = jnp.take(self.embed, tgt, axis=0).astype(self.config.dtype)
+        for block in self.dec_blocks:
+            y = block(y, memory, positions, train)
+        y = self.dec_norm(y)
+        # tied head in f32 (same recipe as TransformerLM)
+        return jnp.einsum("btd,vd->btv", y.astype(jnp.float32),
+                          self.embed.astype(jnp.float32))
+
+    def __call__(self, src: jax.Array, tgt: jax.Array,
+                 train: bool = False) -> jax.Array:
+        return self.decode(tgt, self.encode(src, train), train)
+
+
+def seq2seq_shardings(params: tp.Any) -> tp.Any:
+    """PartitionSpec tree for a Seq2SeqTransformer parameter pytree.
+
+    Same megatron/FSDP rules as `transformer_shardings` (the shared
+    block names match), extended with the cross-attention projections:
+    q [D, H, Dh] column-split, fused kv [D, 2, H, Dh] column-split,
+    out [H, Dh, D] row-split.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(path, leaf) -> P:
+        joined = "/".join(str(getattr(p, "key", p)) for p in path)
+        if "embed" in joined:
+            return P("tensor", "fsdp")
+        if "xattn/q" in joined:
+            base: tp.Tuple = ("fsdp", "tensor", None)
+        elif "xattn/kv" in joined:
+            base = ("fsdp", None, "tensor", None)
+        elif "xattn/out" in joined:
+            base = ("tensor", None, "fsdp")
+        elif "qkv" in joined:
+            base = ("fsdp", None, "tensor", None)
+        elif "attn/out" in joined:
+            base = ("tensor", None, "fsdp")
+        elif "mlp/up" in joined:
+            base = ("fsdp", "tensor")
+        elif "mlp/down" in joined:
+            base = ("tensor", "fsdp")
+        else:
+            base = ()
+        return P(*base[:getattr(leaf, "ndim", 0)])
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def greedy_translate(model: Seq2SeqTransformer, params: tp.Any,
+                     src: jax.Array, *, max_new_tokens: int,
+                     bos_id: int = 1) -> jax.Array:
+    """Greedy decode: returns [B, max_new_tokens] generated tokens.
+
+    The encoder memory is computed ONCE; the scan re-runs only the
+    decoder on a padded static-shape target buffer (causal masking
+    makes the padding inert for already-decoded positions). Exact but
+    O(T^2) in the decoder; long-generation serving belongs to the
+    KV-cache LM decoder.
+    """
+    batch = src.shape[0]
+    memory = model.apply(params, src, method=Seq2SeqTransformer.encode)
+    buf = jnp.full((batch, max_new_tokens + 1), bos_id, jnp.int32)
+
+    def step(buf, t):
+        logits = model.apply(params, buf, memory,
+                             method=Seq2SeqTransformer.decode)
+        nxt = jnp.argmax(logits[:, t], axis=-1).astype(jnp.int32)
+        buf = jax.lax.dynamic_update_index_in_dim(buf, nxt, t + 1, axis=1)
+        return buf, nxt
+
+    _, tokens = jax.lax.scan(step, buf, jnp.arange(max_new_tokens))
+    return tokens.T

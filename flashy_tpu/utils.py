@@ -88,16 +88,38 @@ readonly = freeze
 def pin_platform(default: tp.Optional[str] = None) -> None:
     """Honor an explicit platform request against site configuration.
 
-    Site customizations (TPU plugin autoload) can pin a platform at
-    interpreter start, overriding the `JAX_PLATFORMS` env var. This
-    applies the user's explicit choice — `FLASHY_TPU_PLATFORM`, then
-    `JAX_PLATFORMS`, then `default` — through `jax.config`, which wins.
-    Call before any device query.
+    Site customizations (TPU plugin autoload) can pin a platform LIST
+    at interpreter start (e.g. ``jax_platforms='axon,cpu'``), which
+    overrides the `JAX_PLATFORMS` env var. This applies the user's
+    explicit choice — `FLASHY_TPU_PLATFORM`, then `JAX_PLATFORMS`,
+    then `default` — through `jax.config`, which wins. Call before any
+    device query.
+
+    Two guards keep this from clobbering intent:
+      * `FLASHY_TPU_PLATFORM` is always explicit and always applied;
+      * `JAX_PLATFORMS` can be AMBIENT (exported by the login profile
+        on accelerator hosts), so it is only applied over a
+        multi-platform site pin ('axon,cpu'-style) — a single-platform
+        config means user code already pinned explicitly (e.g.
+        ``jax.config.update("jax_platforms", "cpu")`` at script top)
+        and re-applying the ambient env would override the user and
+        hang on a down tunnel (observed; round-5 regression).
     """
-    choice = (os.environ.get("FLASHY_TPU_PLATFORM")
-              or os.environ.get("JAX_PLATFORMS") or default)
-    if choice:
-        jax.config.update("jax_platforms", choice.strip().lower())
+    explicit = os.environ.get("FLASHY_TPU_PLATFORM")
+    ambient = os.environ.get("JAX_PLATFORMS")
+    current = (getattr(jax.config, "jax_platforms", None) or "")
+    if explicit:
+        choice = explicit
+    elif ambient or default:
+        choice = ambient or default
+        first = current.split(",")[0].strip()
+        if choice.strip().lower() == first.lower():
+            return  # already selected; nothing to win back
+        if current and "," not in current:
+            return  # single-platform config = explicit user pin; keep it
+    else:
+        return
+    jax.config.update("jax_platforms", choice.strip().lower())
 
 
 def device_sync(tree: tp.Any) -> None:

@@ -69,6 +69,16 @@ def _compiled_step(mesh, cfg, batch, seq, param_specs=None):
     return collective_stats(compiled), param_bytes
 
 
+def _replicated_specs(mesh, cfg, batch, seq):
+    """The replication CONTROL both regression tests compare against:
+    same init as _compile_train_step, every param spec collapsed to P()."""
+    model = TransformerLM(cfg, mesh=mesh)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    variables = {"params": model.init(jax.random.PRNGKey(0), tokens)["params"]}
+    return jax.tree_util.tree_map(lambda _: P(), variables)
+
+
 _CFG = dict(vocab_size=128, dim=64, num_layers=2, num_heads=4,
             attention="dense")
 
@@ -93,13 +103,9 @@ def test_fsdp_allgathers_params_replication_regression_fails():
     # param spec silently collapsed to replication. Parameter
     # all-gather traffic must collapse with it — if this assertion
     # ever fails, the accounting itself stopped discriminating.
-    model = TransformerLM(cfg, mesh=mesh)
-    tokens = jnp.asarray(np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (16, 32)), jnp.int32)
-    variables = {"params": model.init(jax.random.PRNGKey(0), tokens)["params"]}
-    replicated_specs = jax.tree_util.tree_map(lambda _: P(), variables)
-    replicated, _ = _compiled_step(mesh, cfg, batch=16, seq=32,
-                                   param_specs=replicated_specs)
+    replicated, _ = _compiled_step(
+        mesh, cfg, batch=16, seq=32,
+        param_specs=_replicated_specs(mesh, cfg, 16, 32))
     assert (replicated["all-gather"]["bytes"]
             < sharded["all-gather"]["bytes"] - 0.4 * param_bytes), (
         sharded, replicated)
@@ -226,13 +232,9 @@ def test_memory_stats_fsdp_shrinks_argument_footprint():
     if not sharded:
         pytest.skip("backend exposes no memory analysis")
 
-    model = TransformerLM(cfg, mesh=mesh)
-    tokens = jnp.asarray(np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (16, 32)), jnp.int32)
-    variables = {"params": model.init(jax.random.PRNGKey(0), tokens)["params"]}
-    replicated_specs = jax.tree_util.tree_map(lambda _: P(), variables)
-    compiled_r, _ = _compile_train_step(mesh, cfg, batch=16, seq=32,
-                                        param_specs=replicated_specs)
+    compiled_r, _ = _compile_train_step(
+        mesh, cfg, batch=16, seq=32,
+        param_specs=_replicated_specs(mesh, cfg, 16, 32))
     replicated = memory_stats(compiled_r)
     # params (and their optimizer/gradient mirrors) dominate the
     # arguments; fsdp=4 must cut them well below the replicated

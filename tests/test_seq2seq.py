@@ -1,0 +1,141 @@
+# Seq2Seq encoder-decoder (models/seq2seq.py). Oracles: decoder
+# causality (future target tokens cannot move earlier logits), encoder
+# bidirectionality THROUGH the cross path (any source position moves
+# any target logit), a learnable sequence-reversal task (the classic
+# seq2seq sanity check — impossible without cross-attention), and
+# TP/FSDP sharding exactness via seq2seq_shardings.
+"""Tests for the encoder-decoder transformer."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from flashy_tpu.models.seq2seq import (Seq2SeqConfig, Seq2SeqTransformer,
+                                       greedy_translate, seq2seq_shardings)
+
+
+def _tiny(**kw):
+    cfg = Seq2SeqConfig(vocab_size=32, dim=32, enc_layers=2, dec_layers=2,
+                        num_heads=2, dtype=jnp.float32, **kw)
+    model = Seq2SeqTransformer(cfg)
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(0, 32, (2, 9)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, 32, (2, 6)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), src, tgt)
+    return cfg, model, params, src, tgt
+
+
+def test_shapes_and_shared_embedding():
+    cfg, model, params, src, tgt = _tiny()
+    logits = model.apply(params, src, tgt)
+    assert logits.shape == (2, 6, 32)
+    # one shared table serves source, target, and the tied head
+    assert params["params"]["embed"].shape == (32, 32)
+
+
+def test_decoder_is_causal_encoder_is_not():
+    cfg, model, params, src, tgt = _tiny()
+    base = np.asarray(model.apply(params, src, tgt))
+
+    # changing the LAST target token must not move earlier logits
+    tgt2 = tgt.at[:, -1].set((tgt[:, -1] + 1) % 32)
+    out2 = np.asarray(model.apply(params, src, tgt2))
+    np.testing.assert_allclose(base[:, :-1], out2[:, :-1], atol=1e-6)
+
+    # ...while changing ANY source token moves even the FIRST target
+    # logit (bidirectional encoder + unmasked cross-attention)
+    src2 = src.at[:, -1].set((src[:, -1] + 1) % 32)
+    out3 = np.asarray(model.apply(params, src2, tgt))
+    assert np.abs(out3[:, 0] - base[:, 0]).max() > 1e-6
+
+
+@pytest.mark.slow
+def test_learns_sequence_reversal():
+    # y = reverse(x): requires real source-target alignment through the
+    # cross-attention — a decoder-only path cannot solve it from the
+    # shifted target alone.
+    rng = np.random.default_rng(4)
+    vocab, seq, n = 16, 8, 256
+    bos = 1
+    src = rng.integers(2, vocab, (n, seq)).astype(np.int32)
+    tgt = src[:, ::-1].copy()
+    dec_in = np.concatenate([np.full((n, 1), bos, np.int32),
+                             tgt[:, :-1]], axis=1)
+
+    cfg = Seq2SeqConfig(vocab_size=vocab, dim=48, enc_layers=1,
+                        dec_layers=1, num_heads=2, dtype=jnp.float32)
+    model = Seq2SeqTransformer(cfg)
+    x_src, x_in, y = (jnp.asarray(a) for a in (src, dec_in, tgt))
+    params = model.init(jax.random.PRNGKey(0), x_src[:1], x_in[:1])
+    optim = optax.adam(3e-3)
+    opt_state = optim.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply(p, x_src, x_in)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optim.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(150):
+        params, opt_state, loss = step(params, opt_state)
+    acc = float((jnp.argmax(model.apply(params, x_src, x_in), -1) == y).mean())
+    assert acc > 0.9, (acc, float(loss))
+
+    # greedy_translate must reproduce the solved task autoregressively
+    # (own predictions fed back, not teacher forcing). Decoded on
+    # training sources: at this size the model memorizes rather than
+    # generalizes the positional rule, and what this asserts is the
+    # DECODE path's exactness, not sample efficiency.
+    out = jax.jit(lambda p, s: greedy_translate(
+        model, p, s, max_new_tokens=seq, bos_id=bos))(params, x_src[:8])
+    match = float((np.asarray(out) == src[:8, ::-1]).mean())
+    assert match > 0.9, match
+
+
+@pytest.mark.slow
+def test_sharded_step_matches_replicated():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from flashy_tpu.parallel import make_mesh, shard_batch
+
+    cfg, model, params, src, tgt = _tiny()
+    mesh = make_mesh({"tensor": 2, "fsdp": 2, "data": 2})
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), seq2seq_shardings(params),
+        is_leaf=lambda x: isinstance(x, P))
+    sharded = jax.device_put(params, shardings)
+    rng = np.random.default_rng(9)
+    src_b = jnp.asarray(rng.integers(0, 32, (8, 9)), jnp.int32)
+    tgt_b = jnp.asarray(rng.integers(0, 32, (8, 6)), jnp.int32)
+
+    def loss(p, s, t):
+        logits = model.apply(p, s, t)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], t[:, 1:]).mean()
+
+    ref = jax.grad(loss)(params, src_b, tgt_b)
+    sb = shard_batch(src_b, mesh, batch_axes=("data",))
+    tb = shard_batch(tgt_b, mesh, batch_axes=("data",))
+    out = jax.jit(jax.grad(loss))(sharded, sb, tb)
+    for a, b in zip(jax.tree_util.tree_leaves(out),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_encode_is_a_standalone_method():
+    # serving computes the memory once: encode must be callable via
+    # apply(method=...) outside the full forward (a compact-module
+    # regression would raise AssignSubModuleError here)
+    cfg, model, params, src, tgt = _tiny()
+    memory = model.apply(params, src, method=Seq2SeqTransformer.encode)
+    assert memory.shape == (2, 9, cfg.dim)
+    logits = model.apply(params, tgt, memory,
+                         method=Seq2SeqTransformer.decode)
+    full = model.apply(params, src, tgt)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
+                               atol=1e-6)
