@@ -95,6 +95,7 @@ def test_vit_learns_synthetic_classes():
     assert acc > 0.9, (acc, float(loss))
 
 
+@pytest.mark.slow
 def test_vit_data_parallel_step_matches_single():
     # DP over the virtual mesh through parallel.wrap — the shared-block
     # sharding story carries over to the vision family
