@@ -7,8 +7,8 @@ from .moe import MoEMLP, moe_aux_loss
 from .resnet import ResNet, resnet18, resnet34, resnet50
 from .transformer import TransformerLM, TransformerConfig, transformer_shardings
 from .vit import ViT, ViTConfig, vit_tiny, vit_small
-from .seq2seq import (Seq2SeqConfig, Seq2SeqTransformer, greedy_translate,
-                      seq2seq_shardings)
+from .seq2seq import (Seq2SeqConfig, Seq2SeqTransformer, cached_translate,
+                      greedy_translate, init_decode_cache, seq2seq_shardings)
 from .decoding import generate, init_cache, nucleus_filter
 from .quantize import (quantize_lm_params, dequantize_lm_params,
                        is_quantized)

@@ -154,10 +154,15 @@ def _moe_forward(cfg: TransformerConfig, mp: tp.Dict, x: jax.Array) -> jax.Array
     return out.reshape(batch, seq, dim).astype(cfg.dtype)
 
 
-def _layer_forward(cfg: TransformerConfig, bp: tp.Dict, x: jax.Array,
-                   positions: jax.Array, k_cache: jax.Array,
-                   v_cache: jax.Array, cache_index: jax.Array):
-    """One block against cached K/V: returns (x, k_cache, v_cache)."""
+def _cached_self_attention(cfg, bp: tp.Dict, x: jax.Array,
+                           positions: jax.Array, k_cache: jax.Array,
+                           v_cache: jax.Array, cache_index: jax.Array):
+    """Pre-norm causal self-attention against the K/V cache.
+
+    Returns (x + attn_out, k_cache, v_cache). `cfg` only needs
+    `.dtype`/`.head_dim`, so the seq2seq decoder shares this body (and
+    its quantized-kernel support) — ONE implementation of the cache
+    update + causal-prefix mask recipe."""
     normed = _rmsnorm(x, bp["norm1"]["scale"], cfg.dtype)
     qkv_w, qkv_s = _kernel(bp["attn"]["qkv"]["kernel"], cfg.dtype)
     qkv = _postscale(jnp.einsum("btd,dchk->btchk", normed, qkv_w), qkv_s)
@@ -182,20 +187,31 @@ def _layer_forward(cfg: TransformerConfig, bp: tp.Dict, x: jax.Array,
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v_cache)
     out_w, out_s = _kernel(bp["attn"]["out"]["kernel"], cfg.dtype)
     attn_out = _postscale(jnp.einsum("bqhd,hdD->bqD", attn, out_w), out_s)
-    x = x + attn_out
+    return x + attn_out, k_cache, v_cache
 
+
+def _gated_mlp(bp_mlp: tp.Dict, normed: jax.Array, dtype) -> jax.Array:
+    """SwiGLU MLP on pre-normed input (quantized kernels supported)."""
+    up_w, up_s = _kernel(bp_mlp["up"]["kernel"], dtype)
+    up = _postscale(jnp.einsum("btd,df->btf", normed, up_w), up_s)
+    gate, value = jnp.split(up, 2, axis=-1)
+    down_w, down_s = _kernel(bp_mlp["down"]["kernel"], dtype)
+    return _postscale(
+        jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * value, down_w),
+        down_s)
+
+
+def _layer_forward(cfg: TransformerConfig, bp: tp.Dict, x: jax.Array,
+                   positions: jax.Array, k_cache: jax.Array,
+                   v_cache: jax.Array, cache_index: jax.Array):
+    """One block against cached K/V: returns (x, k_cache, v_cache)."""
+    x, k_cache, v_cache = _cached_self_attention(
+        cfg, bp, x, positions, k_cache, v_cache, cache_index)
     normed = _rmsnorm(x, bp["norm2"]["scale"], cfg.dtype)
     if "moe" in bp:
         x = x + _moe_forward(cfg, bp["moe"], normed)
     else:
-        up_w, up_s = _kernel(bp["mlp"]["up"]["kernel"], cfg.dtype)
-        up = _postscale(jnp.einsum("btd,df->btf", normed, up_w), up_s)
-        gate, value = jnp.split(up, 2, axis=-1)
-        down_w, down_s = _kernel(bp["mlp"]["down"]["kernel"], cfg.dtype)
-        mlp_out = _postscale(
-            jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * value, down_w),
-            down_s)
-        x = x + mlp_out
+        x = x + _gated_mlp(bp["mlp"], normed, cfg.dtype)
     return x, k_cache, v_cache
 
 

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
-from .transformer import Attention, Block, MLPBlock, TransformerConfig
+from .transformer import (Attention, Block, MLPBlock, TransformerConfig,
+                          rmsnorm)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -187,6 +188,116 @@ def seq2seq_shardings(params: tp.Any) -> tp.Any:
         return P(*base[:getattr(leaf, "ndim", 0)])
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def _precompute_cross_kv(cfg: Seq2SeqConfig, p: tp.Dict,
+                         memory: jax.Array) -> tp.List:
+    """Cross K/V per decoder block, computed ONCE per generation.
+
+    The cross-attention keys/values depend only on the encoder memory —
+    loop-invariant across decode steps — so caching them turns the
+    per-step cross sublayer into one [B,1,H,Dh] @ [B,S,H,Dh] attention
+    with no projection matmuls."""
+    out = []
+    for i in range(cfg.dec_layers):
+        kernel = p[f"dec_blocks_{i}"]["xattn"]["kv"]["kernel"]
+        kv = jnp.einsum("bsd,dchk->bschk", memory.astype(cfg.dtype),
+                        kernel.astype(cfg.dtype))
+        out.append((kv[:, :, 0], kv[:, :, 1]))
+    return out
+
+
+def init_decode_cache(cfg: Seq2SeqConfig, batch: int,
+                      max_len: int) -> tp.Dict:
+    """Self-attention K/V cache for the decoder blocks."""
+    shape = (batch, max_len, cfg.num_heads, cfg.head_dim)
+    return {f"dec_blocks_{i}": {"k": jnp.zeros(shape, cfg.dtype),
+                                "v": jnp.zeros(shape, cfg.dtype)}
+            for i in range(cfg.dec_layers)}
+
+
+def _dec_step(cfg: Seq2SeqConfig, p: tp.Dict, tokens: jax.Array,
+              positions: jax.Array, cache: tp.Dict,
+              cache_index: jax.Array, cross_kv: tp.List):
+    """Decoder forward of `tokens` [B, S] against the caches.
+
+    Mirrors `Seq2SeqTransformer.decode` exactly (same kernels, same
+    f32 softmax/logit recipe) but attends to the cached self-attention
+    prefix and the precomputed cross K/V. The self-attention and MLP
+    bodies are decoding.py's shared cached-layer helpers (one
+    implementation of the cache-update + prefix-mask recipe, quantized
+    kernels included); only the cross sublayer is seq2seq-specific.
+    Returns (logits, new_cache).
+    """
+    from .decoding import _cached_self_attention, _gated_mlp
+
+    x = jnp.take(p["embed"], tokens, axis=0).astype(cfg.dtype)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    new_cache = {}
+    for i in range(cfg.dec_layers):
+        name = f"dec_blocks_{i}"
+        bp = p[name]
+        x, k_cache, v_cache = _cached_self_attention(
+            cfg, bp, x, positions, cache[name]["k"], cache[name]["v"],
+            cache_index)
+        new_cache[name] = {"k": k_cache, "v": v_cache}
+
+        # -- cross-attention against the precomputed memory K/V --
+        normed = rmsnorm(x, bp["norm2"]["scale"], cfg.dtype)
+        qx = jnp.einsum("btd,dhk->bthk", normed,
+                        bp["xattn"]["q"]["kernel"].astype(cfg.dtype))
+        kx, vx = cross_kv[i]
+        xs = jnp.einsum("bqhd,bkhd->bhqk", qx, kx,
+                        preferred_element_type=jnp.float32) * scale
+        xp = jax.nn.softmax(xs, axis=-1)
+        xa = jnp.einsum("bhqk,bkhd->bqhd", xp.astype(cfg.dtype), vx)
+        x = x + jnp.einsum("bqhd,hdD->bqD", xa,
+                           bp["xattn"]["out"]["kernel"].astype(cfg.dtype))
+
+        x = x + _gated_mlp(bp["mlp"],
+                           rmsnorm(x, bp["norm3"]["scale"], cfg.dtype),
+                           cfg.dtype)
+
+    x = rmsnorm(x, p["dec_norm"]["scale"], cfg.dtype)
+    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                        p["embed"].astype(jnp.float32))
+    return logits, new_cache
+
+
+def cached_translate(model: Seq2SeqTransformer, params: tp.Any,
+                     src: jax.Array, *, max_new_tokens: int,
+                     bos_id: int = 1) -> jax.Array:
+    """Greedy decode with KV caches: O(T) per step instead of O(T^2).
+
+    The encoder runs once; the cross K/V are precomputed per block;
+    each step runs the decoder on ONE token against the cached
+    self-attention prefix. Same argmax chain as `greedy_translate`
+    (the oracle tests assert token-exact agreement).
+    """
+    cfg = model.config
+    if max_new_tokens + 1 > cfg.max_seq_len:
+        raise ValueError(
+            f"max_new_tokens + 1 = {max_new_tokens + 1} exceeds "
+            f"max_seq_len={cfg.max_seq_len}")
+    batch = src.shape[0]
+    memory = model.apply(params, src, method=Seq2SeqTransformer.encode)
+    p = params["params"]
+    cross_kv = _precompute_cross_kv(cfg, p, memory)
+    cache = init_decode_cache(cfg, batch, max_new_tokens + 1)
+
+    bos = jnp.full((batch, 1), bos_id, jnp.int32)
+
+    def step(carry, t):
+        token, cache = carry
+        positions = jnp.broadcast_to(t, (batch, 1)).astype(jnp.int32)
+        logits, cache = _dec_step(cfg, p, token, positions, cache, t,
+                                  cross_kv)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        return (nxt, cache), nxt[:, 0]
+
+    (_, _), tokens = jax.lax.scan(step, (bos, cache),
+                                  jnp.arange(max_new_tokens))
+    return tokens.T
 
 
 def greedy_translate(model: Seq2SeqTransformer, params: tp.Any,
