@@ -65,8 +65,10 @@ LEGS_BUDGET_S = float(os.environ.get("FLASHY_TPU_BENCH_BUDGET", "2400"))
 REPROBE_INTERVAL_S = float(os.environ.get("FLASHY_TPU_BENCH_REPROBE", "240"))
 # ...and once every leg has finished as CPU fallback, it keeps probing
 # for this much longer before settling for the CPU record (bounded so a
-# dead tunnel can't stall the bench past the driver's patience).
-CPU_RECOVERY_WAIT_S = float(os.environ.get("FLASHY_TPU_BENCH_CPU_WAIT", "600"))
+# dead tunnel can't stall the bench past the driver's patience — the
+# stdout JSON line only prints after this window, so its cost rides on
+# top of the full CPU leg phase).
+CPU_RECOVERY_WAIT_S = float(os.environ.get("FLASHY_TPU_BENCH_CPU_WAIT", "360"))
 
 # Partial results land here as each leg completes, so a bench killed
 # mid-run (driver timeout, tunnel collapse) still leaves its numbers.
