@@ -28,6 +28,8 @@ __version__ = "0.4.0"
 
 from . import distrib  # noqa
 from . import adversarial  # noqa
+from . import observability  # noqa
+from .observability import Tracer, StepTimer, enable_telemetry  # noqa
 from .formatter import Formatter  # noqa
 from .logging import ResultLogger, LogProgressBar, bold, setup_logging  # noqa
 from .solver import BaseSolver  # noqa

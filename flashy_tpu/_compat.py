@@ -1,0 +1,57 @@
+# JAX version shims. The kernels and shard_map call sites are written
+# against the current JAX API (top-level `jax.shard_map`, the
+# varying-manual-axes type system: `jax.typeof(x).vma`,
+# `ShapeDtypeStruct(..., vma=...)`, `jax.lax.pcast`, `check_vma=`);
+# older 0.4.x runtimes predate all of it — there the vma concept simply
+# does not exist (shard_map tracks "replication" via `check_rep`
+# instead), so dropping the annotations is semantically exact, not an
+# approximation. Everything here resolves to the native API when it
+# exists, so behavior on current JAX is byte-identical.
+"""Shims over JAX API differences (shard_map spelling, vma types)."""
+import typing as tp
+
+import jax
+
+try:  # the old experimental location; current jax exposes jax.shard_map
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+except ImportError:  # pragma: no cover - future jax removes the alias
+    _experimental_shard_map = None
+
+# The varying-manual-axes type system arrived with jax.typeof.
+HAS_VMA = hasattr(jax, "typeof")
+
+
+def vma_of(x: tp.Any) -> frozenset:
+    """`jax.typeof(x).vma`, or an empty set on jax without vma types."""
+    if HAS_VMA:
+        return jax.typeof(x).vma
+    return frozenset()
+
+
+def shape_dtype_struct(shape: tp.Sequence[int], dtype: tp.Any,
+                       vma: tp.Optional[frozenset] = None) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct carrying `vma` when this jax understands it."""
+    if HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma or frozenset())
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def pcast_varying(x: tp.Any, axes: tp.Sequence[str]) -> tp.Any:
+    """`jax.lax.pcast(x, axes, to='varying')`; identity without vma
+    types (nothing to annotate — values are implicitly varying)."""
+    if not axes:
+        return x
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axes), to="varying")
+    return x
+
+
+def shard_map(f: tp.Callable, mesh: tp.Any, in_specs: tp.Any,
+              out_specs: tp.Any, check_vma: bool = True) -> tp.Callable:
+    """`jax.shard_map` with the `check_vma`/`check_rep` kwarg bridged."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    assert _experimental_shard_map is not None
+    return _experimental_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_rep=check_vma)

@@ -8,11 +8,28 @@
 # waits on the host.
 """DataLoader: sharded batching + device prefetch for TPU training."""
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import nullcontext
 import collections.abc
 import typing as tp
 
 import jax
 import numpy as np
+
+
+def _data_tracer():
+    """The active telemetry tracer, or None (one cheap lookup per
+    epoch/iterator — batch fetches then show up as `data/fetch` spans
+    alongside the solver's step split in the Perfetto trace)."""
+    from ..observability import get_telemetry
+    telemetry = get_telemetry()
+    return None if telemetry is None else telemetry.tracer
+
+
+def _span(tracer, name: str):
+    """A `data`-category span on `tracer`, or a no-op context when
+    telemetry is off."""
+    return (tracer.span(name, category="data") if tracer is not None
+            else nullcontext())
 
 
 class StridedShard:
@@ -212,12 +229,14 @@ class DataLoader:
         padded = own + [pad_src[i % len(pad_src)]
                         for i in range(total - valid)]
         starts = range(0, total, self.batch_size)
+        tracer = _data_tracer()
 
         def fetch(start, sample_map):
-            idxs = padded[start:start + self.batch_size]
-            samples = list(sample_map(self.dataset.__getitem__, idxs))
-            mask = np.arange(start, start + self.batch_size) < valid
-            return self.collate_fn(samples), mask
+            with _span(tracer, "data/fetch"):
+                idxs = padded[start:start + self.batch_size]
+                samples = list(sample_map(self.dataset.__getitem__, idxs))
+                mask = np.arange(start, start + self.batch_size) < valid
+                return self.collate_fn(samples), mask
 
         if self.num_workers > 0:
             executor = ThreadPoolExecutor(max_workers=self.num_workers)
@@ -237,21 +256,21 @@ class DataLoader:
                    for i in range(0, len(indices), self.batch_size)]
         if self.drop_last:
             batches = [b for b in batches if len(b) == self.batch_size]
+        tracer = _data_tracer()
+
+        def fetch(batch_indices, sample_map):
+            with _span(tracer, "data/fetch"):
+                samples = list(sample_map(self.dataset.__getitem__, batch_indices))
+                return self.collate_fn(samples)
 
         if self.num_workers > 0:
             executor = ThreadPoolExecutor(max_workers=self.num_workers)
-
-            def fetch(batch_indices):
-                samples = list(executor.map(self.dataset.__getitem__, batch_indices))
-                return self.collate_fn(samples)
-
             try:
-                yield from (fetch(b) for b in batches)
+                yield from (fetch(b, executor.map) for b in batches)
             finally:
                 executor.shutdown(wait=False)
         else:
-            for batch_indices in batches:
-                yield self.collate_fn([self.dataset[i] for i in batch_indices])
+            yield from (fetch(b, map) for b in batches)
 
 
 def masked_mean(per_sample: tp.Dict[str, tp.Any], mask: np.ndarray
@@ -290,10 +309,16 @@ def prefetch_to_device(iterator: tp.Iterable[tp.Any], size: int = 2,
     import collections
     queue: collections.deque = collections.deque()
     iterator = iter(iterator)
+    tracer = _data_tracer()
+
+    def enqueue(batch):
+        with _span(tracer, "data/host_to_device"):
+            queue.append(shard_batch(batch, mesh=mesh, batch_axes=batch_axes))
+
     try:
         while True:
             while len(queue) < size:
-                queue.append(shard_batch(next(iterator), mesh=mesh, batch_axes=batch_axes))
+                enqueue(next(iterator))
             yield queue.popleft()
     except StopIteration:
         while queue:

@@ -15,10 +15,13 @@
 # `register_stateful("ema")` checkpoints and restores it like any
 # other state.
 """Exponential moving average of parameters, TPU-resident."""
+import logging
 import typing as tp
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
 
 
 def ema_update(shadow: tp.Any, params: tp.Any, decay: float = 0.999,
@@ -76,13 +79,37 @@ class EMA:
         return {"decay": self.decay, "shadow": self.shadow}
 
     def load_state_dict(self, state: tp.Dict[str, tp.Any]) -> None:
-        self.decay = float(state["decay"])
-        # restore onto the live shadow's shardings/dtypes when shapes
-        # match (checkpoint may come back as host numpy arrays)
+        # The constructor-configured decay wins over the checkpointed
+        # one: changing ema decay in the config and resuming must take
+        # effect (silently keeping the old value was the trap) — but
+        # loudly, so an unintended config drift is visible.
+        checkpoint_decay = float(state["decay"])
+        if abs(checkpoint_decay - self.decay) > 1e-12:
+            logger.warning(
+                "EMA decay mismatch on restore: checkpoint has %.6g, live "
+                "config has %.6g; keeping the live value.",
+                checkpoint_decay, self.decay)
+        # restore onto the live shadow's shardings/dtypes (checkpoint
+        # may come back as host numpy arrays)
         restored = state["shadow"]
         live = jax.tree_util.tree_leaves(self.shadow)
         flat, treedef = jax.tree_util.tree_flatten(restored)
-        if live and len(live) == len(flat):
+        if live:
+            if len(live) != len(flat):
+                raise ValueError(
+                    f"EMA restore: checkpointed shadow has {len(flat)} "
+                    f"leaves, live shadow has {len(live)} — the model "
+                    f"structure changed since the checkpoint was written.")
+            mismatched = [
+                f"leaf {i}: checkpoint {tuple(jnp.shape(r))} vs live "
+                f"{tuple(l.shape)}"
+                for i, (r, l) in enumerate(zip(flat, live))
+                if hasattr(l, "shape") and tuple(jnp.shape(r)) != tuple(l.shape)]
+            if mismatched:
+                raise ValueError(
+                    "EMA restore: shadow leaf shapes differ from the live "
+                    "shadow (shape-blind unflattening would corrupt the "
+                    "EMA):\n  " + "\n  ".join(mismatched))
             flat = [jnp.asarray(r).astype(l.dtype) if hasattr(l, "dtype")
                     else r for r, l in zip(flat, live)]
         self.shadow = jax.tree_util.tree_unflatten(treedef, flat)

@@ -8,8 +8,10 @@ from pathlib import Path
 
 
 def collect(root: Path):
-    """Yield {sig, cfg, argv, history} for every XP under root."""
-    from .xp import CONFIG_SNAPSHOT_NAME, RUN_INFO_NAME, Link
+    """Yield {sig, cfg, argv, history, telemetry} for every XP under root."""
+    from .xp import (CONFIG_SNAPSHOT_NAME, HEARTBEAT_DIR_NAME, RUN_INFO_NAME,
+                     Link)
+    from .observability import straggler_report
 
     xps_dir = root / "xps"
     if not xps_dir.is_dir():
@@ -17,7 +19,8 @@ def collect(root: Path):
     for folder in sorted(xps_dir.iterdir()):
         if not folder.is_dir():
             continue
-        entry = {"sig": folder.name, "cfg": {}, "argv": [], "history": []}
+        entry = {"sig": folder.name, "cfg": {}, "argv": [], "history": [],
+                 "telemetry": {}}
         config_path = folder / CONFIG_SNAPSHOT_NAME
         if config_path.exists():
             with open(config_path) as f:
@@ -27,6 +30,9 @@ def collect(root: Path):
             with open(run_info_path) as f:
                 entry["argv"] = json.load(f).get("argv", [])
         entry["history"] = Link(folder).load()
+        heartbeat_dir = folder / HEARTBEAT_DIR_NAME
+        if heartbeat_dir.is_dir():
+            entry["telemetry"] = straggler_report(heartbeat_dir)
         yield entry
 
 
@@ -46,9 +52,34 @@ def format_entry(entry, verbose: bool = False) -> str:
                 parts.append(f"{stage}: {shown}")
         if parts:
             line += "  " + " | ".join(parts)
+    if entry.get("telemetry", {}).get("ranks"):
+        from .observability import format_straggler_report
+        line += "\n  heartbeats: " + format_straggler_report(entry["telemetry"])
     if verbose:
         line += "\n  cfg: " + json.dumps(entry["cfg"], default=str)[:500]
     return line
+
+
+def format_device_stats() -> str:
+    """Live per-device HBM occupancy of THIS host's devices.
+
+    Uses `jax.Device.memory_stats()` — the runtime complement of the
+    compile-time `parallel.accounting.memory_stats`. Backends without
+    the API (CPU) report only the device list.
+    """
+    from .observability import device_memory_stats
+
+    lines = []
+    for entry in device_memory_stats():
+        line = f"device {entry['id']} [{entry['platform']}] {entry['kind']}"
+        if "bytes_in_use" in entry:
+            line += f"  in_use={entry['bytes_in_use'] / 2**30:.2f}G"
+        if "peak_bytes_in_use" in entry:
+            line += f"  peak={entry['peak_bytes_in_use'] / 2**30:.2f}G"
+        if "bytes_limit" in entry:
+            line += f"  limit={entry['bytes_limit'] / 2**30:.2f}G"
+        lines.append(line)
+    return "\n".join(lines) or "no devices"
 
 
 def main(argv=None) -> int:
@@ -59,7 +90,13 @@ def main(argv=None) -> int:
                         help="output root (the folder containing xps/)")
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="also print each XP's config")
+    parser.add_argument("-d", "--devices", action="store_true",
+                        help="also print live per-device memory stats for "
+                             "this host (initializes the JAX backend)")
     args = parser.parse_args(argv)
+
+    if args.devices:
+        print(format_device_stats())
 
     found = False
     for entry in collect(Path(args.root)):

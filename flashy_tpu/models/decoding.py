@@ -13,10 +13,12 @@
 # buffers are a *training* batching artifact with no meaning for
 # autoregressive decoding).
 """KV-cache decoding: generate(model, params, prompt, ...) -> tokens."""
+import numbers
 import typing as tp
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .transformer import TransformerConfig, _rotary, rmsnorm as _rmsnorm
 from .quantize import is_quantized
@@ -280,12 +282,23 @@ def nucleus_filter(logits: jax.Array, top_p: float) -> jax.Array:
     with the cutoff logit all stay eligible (dropping an arbitrary
     subset of equally-likely tokens would bias the distribution).
     Ineligible logits are masked to -1e30. Jit-safe (one sort + cumsum,
-    no dynamic shapes); `logits` is [..., vocab].
+    no dynamic shapes); `logits` is [..., vocab]. A concrete `top_p`
+    must be in (0, 1]: `top_p <= 0` would make EVERY position
+    ineligible (near-uniform sampling over -1e30 logits) and is
+    rejected loudly instead.
     """
+    # concrete values only: python scalars AND numpy scalars (np.float32
+    # is not a python float); traced values can't be range-checked.
+    if (isinstance(top_p, (numbers.Real, np.number))
+            and not 0.0 < float(top_p) <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum_before = jnp.cumsum(probs, axis=-1) - probs
     eligible = cum_before < top_p
+    # The argmax survives unconditionally — also for traced top_p values
+    # the concreteness check above cannot see.
+    eligible = eligible.at[..., 0].set(True)
     # cutoff = the smallest sorted logit still eligible per row
     cutoff = jnp.min(jnp.where(eligible, sorted_logits, jnp.inf),
                      axis=-1, keepdims=True)

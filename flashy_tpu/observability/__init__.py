@@ -1,0 +1,38 @@
+# Runtime telemetry for flashy_tpu — the profiler subsystem the
+# reference never shipped (SURVEY §5). Four pieces, one switch:
+#
+#  * Tracer            host-side spans -> Perfetto trace + telemetry.jsonl
+#  * StepTimer         data-wait / host / device split per training step
+#  * RecompileWatchdog WARN when a jitted fn recompiles after warm-up
+#  * Heartbeat         per-rank liveness files + cross-host straggler report
+#
+# `enable_telemetry()` (or `solver.enable_telemetry()`) turns everything
+# on; the solver's stage loop, LogProgressBar and DataLoader then feed
+# it automatically. Complements `solver.enable_profiling` (the XLA
+# device-op timeline): profiling answers "what is the device doing",
+# telemetry answers "why is the step slower than the device time".
+#
+# This module must stay importable with no accelerator present and must
+# not initialize a JAX backend at import time (tests enforce it): jax
+# is only imported inside functions that genuinely touch devices.
+"""Runtime telemetry: tracing, step timing, recompile and straggler watch."""
+
+from .tracer import Tracer  # noqa
+from .steptimer import StepTimer  # noqa
+from .watchdog import RecompileWatchdog  # noqa
+from .heartbeat import (  # noqa
+    Heartbeat, device_memory_stats, read_heartbeats, straggler_report,
+    format_straggler_report,
+)
+from .telemetry import (  # noqa
+    Telemetry, enable_telemetry, disable_telemetry, get_telemetry,
+    TELEMETRY_NAME, TRACE_NAME, HEARTBEAT_DIR_NAME,
+)
+
+__all__ = [
+    "Tracer", "StepTimer", "RecompileWatchdog", "Heartbeat", "Telemetry",
+    "enable_telemetry", "disable_telemetry", "get_telemetry",
+    "device_memory_stats", "read_heartbeats", "straggler_report",
+    "format_straggler_report",
+    "TELEMETRY_NAME", "TRACE_NAME", "HEARTBEAT_DIR_NAME",
+]

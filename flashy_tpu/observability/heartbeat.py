@@ -1,0 +1,162 @@
+# Per-rank heartbeats + straggler detection. On a pod, the failure mode
+# that wastes the most accelerator-hours is not a crash (crashes are
+# loud) but one host silently falling behind: every collective then
+# runs at the straggler's pace and the whole pod bills for it. Each
+# process atomically rewrites a tiny per-rank JSON file at step/stage
+# boundaries; any other process (or `python -m flashy_tpu.info` on the
+# shared filesystem) can read the set and compute cross-host step skew
+# and staleness without any collective — exactly the per-rank event
+# journaling a hung pod still leaves behind.
+"""Heartbeat files per rank + straggler report over an XP folder."""
+from pathlib import Path
+import json
+import os
+import socket
+import time
+import typing as tp
+
+from ..utils import AnyPath, write_and_rename
+
+HEARTBEAT_PREFIX = "rank"
+
+
+def device_memory_stats() -> tp.List[tp.Dict[str, tp.Any]]:
+    """Live per-device HBM stats via `jax.Device.memory_stats()`.
+
+    The runtime companion of `parallel.accounting.memory_stats` (which
+    is compile-time): what the devices actually hold right now. Imports
+    jax lazily and degrades to [] on backends that expose no stats
+    (CPU) — safe to call from heartbeat paths on any platform.
+    """
+    import jax
+
+    out: tp.List[tp.Dict[str, tp.Any]] = []
+    try:
+        devices = jax.local_devices()
+    except RuntimeError:  # no backend available
+        return out
+    for device in devices:
+        stats = None
+        try:
+            stats = device.memory_stats()
+        except Exception:  # backend without the API
+            stats = None
+        entry: tp.Dict[str, tp.Any] = {"id": device.id,
+                                       "platform": device.platform,
+                                       "kind": getattr(device, "device_kind", "")}
+        if stats:
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                        "largest_free_block_bytes"):
+                if key in stats:
+                    entry[key] = int(stats[key])
+        out.append(entry)
+    return out
+
+
+class Heartbeat:
+    """Atomically rewrites `<folder>/rank{r}.json` with liveness info.
+
+    `beat()` is throttled to one write per `interval` seconds (step
+    loops call it every step; `force=True` for stage boundaries). The
+    write is atomic (write + rename), so readers never see a torn file.
+    `with_device_stats` samples `device_memory_stats()` into each beat —
+    on-by-default live HBM occupancy per rank.
+    """
+
+    def __init__(self, folder: AnyPath, rank: int = 0, world_size: int = 1,
+                 interval: float = 10.0, with_device_stats: bool = True):
+        self.folder = Path(folder)
+        self.rank = rank
+        self.world_size = world_size
+        self.interval = interval
+        self.with_device_stats = with_device_stats
+        self._last_beat = float("-inf")
+        self.folder.mkdir(parents=True, exist_ok=True)
+
+    @property
+    def path(self) -> Path:
+        return self.folder / f"{HEARTBEAT_PREFIX}{self.rank}.json"
+
+    def beat(self, step: tp.Optional[int] = None, epoch: tp.Optional[int] = None,
+             stage: tp.Optional[str] = None, force: bool = False,
+             **extra: tp.Any) -> bool:
+        """Write a heartbeat unless one was written < `interval` ago.
+
+        Returns True when a file was actually written.
+        """
+        now = time.monotonic()
+        if not force and now - self._last_beat < self.interval:
+            return False
+        self._last_beat = now
+        payload: tp.Dict[str, tp.Any] = {
+            "rank": self.rank, "world_size": self.world_size,
+            "time": time.time(), "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "step": step, "epoch": epoch, "stage": stage,
+        }
+        payload.update(extra)
+        if self.with_device_stats:
+            payload["devices"] = device_memory_stats()
+        with write_and_rename(self.path, "w", pid=True) as f:
+            json.dump(payload, f, default=float)
+        return True
+
+
+def read_heartbeats(folder: AnyPath) -> tp.List[tp.Dict[str, tp.Any]]:
+    """All parseable per-rank heartbeat payloads under `folder`, by rank."""
+    folder = Path(folder)
+    if not folder.is_dir():
+        return []
+    beats = []
+    for path in sorted(folder.glob(f"{HEARTBEAT_PREFIX}*.json")):
+        try:
+            with open(path) as f:
+                beats.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue  # mid-rewrite or corrupt: skip, don't crash the reader
+    beats.sort(key=lambda b: b.get("rank", 0))
+    return beats
+
+
+def straggler_report(folder: AnyPath,
+                     now: tp.Optional[float] = None) -> tp.Dict[str, tp.Any]:
+    """Cross-rank liveness summary from the heartbeat files.
+
+    Returns ``{"ranks", "expected", "missing", "max_step_skew",
+    "stalest_rank", "stalest_age", "per_rank"}`` where `max_step_skew`
+    is the spread between the fastest and slowest rank's last reported
+    step and `stalest_age` is seconds since the oldest heartbeat.
+    Empty folder -> ``{"ranks": 0}``.
+    """
+    beats = read_heartbeats(folder)
+    if not beats:
+        return {"ranks": 0}
+    now = time.time() if now is None else now
+    expected = max(b.get("world_size") or 1 for b in beats)
+    seen = {b.get("rank", 0) for b in beats}
+    steps = [b["step"] for b in beats if b.get("step") is not None]
+    ages = [(now - b["time"], b.get("rank", 0)) for b in beats if "time" in b]
+    stalest_age, stalest_rank = max(ages) if ages else (0.0, None)
+    return {
+        "ranks": len(beats),
+        "expected": expected,
+        "missing": sorted(set(range(expected)) - seen),
+        "max_step_skew": (max(steps) - min(steps)) if steps else 0,
+        "stalest_rank": stalest_rank,
+        "stalest_age": stalest_age,
+        "per_rank": beats,
+    }
+
+
+def format_straggler_report(report: tp.Dict[str, tp.Any]) -> str:
+    """One-line human rendering of `straggler_report` (info CLI)."""
+    if not report.get("ranks"):
+        return "no heartbeats"
+    parts = [f"{report['ranks']}/{report.get('expected', report['ranks'])} ranks"]
+    if report.get("missing"):
+        parts.append("missing " + ",".join(str(r) for r in report["missing"]))
+    parts.append(f"step skew {report.get('max_step_skew', 0)}")
+    if report.get("stalest_rank") is not None:
+        parts.append(f"stalest rank {report['stalest_rank']} "
+                     f"({report['stalest_age']:.1f}s ago)")
+    return " | ".join(parts)

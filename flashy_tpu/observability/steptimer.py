@@ -1,0 +1,152 @@
+# Step-time decomposition. The step-time accounting backbone of the
+# pjit/TPUv4 scaling methodology (PAPERS.md): a training step's wall
+# clock is data-wait (the loader didn't have the next batch ready) +
+# host (python between batch arrival and dispatch, incl. tracing and
+# compilation) + device (XLA compute still in flight at the step
+# boundary). The split immediately names the bottleneck — a
+# data_wait-bound stage needs loader workers/prefetch, a host-bound one
+# needs less python per step, a device-bound one is running as fast as
+# the hardware allows.
+"""StepTimer: per-step data-wait / host / device wall-clock split."""
+import time
+import typing as tp
+
+from .tracer import Tracer
+
+
+def _percentile(values: tp.Sequence[float], q: float) -> float:
+    """Nearest-rank percentile without numpy (values need not be sorted)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    index = min(len(ordered) - 1, max(0, int(round(q / 100.0 * (len(ordered) - 1)))))
+    return ordered[index]
+
+
+class StepTimer:
+    """Splits each loop iteration into data-wait / host / device time.
+
+    Driven from the step boundary (LogProgressBar does this when a timer
+    is attached; manual use follows the same protocol)::
+
+        timer.begin_data()        # closes the previous step, if any
+        batch = next(iterator)
+        timer.end_data()          # host phase starts
+        out = step_fn(batch)      # async dispatch under jit
+        timer.observe(out)        # block here; the wait is device time
+
+    Device time is bounded via `jax.block_until_ready` INSIDE
+    `observe()`: the blocking wait is charged to `device` and
+    subtracted from the surrounding host segment. Blocking at the
+    observe call (rather than the next step boundary) keeps the split
+    honest in the canonical loop, where the very next host statement
+    floats the same outputs into a metrics averager — deferred blocking
+    would find them already complete and silently charge the device
+    wait to `host`. Without `observe()` that is exactly what happens:
+    the device work completes inside whatever host call first needs the
+    values (e.g. `float(metric)`) and is charged to `host`.
+
+    Per-step records land in the tracer's journal as
+    ``{"type": "step", "stage": ..., "step": i, "data_wait": s,
+    "host": s, "device": s, "total": s}`` and as three trace spans, so
+    the split is visible both in Perfetto and in `telemetry.jsonl`.
+    """
+
+    def __init__(self, stage: str = "", tracer: tp.Optional[Tracer] = None,
+                 on_step: tp.Optional[tp.Callable[[tp.Dict[str, float]], None]] = None):
+        self.stage = stage
+        self.tracer = tracer
+        self.on_step = on_step
+        self.records: tp.List[tp.Dict[str, float]] = []
+        self._device: float = 0.0
+        self._device_at: tp.Optional[float] = None
+        self._data_start: tp.Optional[float] = None
+        self._data_wait: float = 0.0
+        self._host_start: tp.Optional[float] = None
+        self._step_start: tp.Optional[float] = None
+        # The journal/heartbeat IO of closing step N happens after N's
+        # timings are frozen; it is carried into step N+1's host time so
+        # the per-step splits still tile the stage wall clock.
+        self._carry_overhead: float = 0.0
+
+    def begin_data(self) -> None:
+        """Mark a step boundary: close the in-flight step, start data wait."""
+        self._close_step()
+        self._data_start = time.perf_counter()
+
+    def end_data(self) -> None:
+        """The batch arrived: data wait ends, the host phase begins."""
+        now = time.perf_counter()
+        if self._data_start is None:
+            self._data_start = now
+        self._data_wait = now - self._data_start
+        self._step_start = self._data_start
+        self._host_start = now
+        self._data_start = None
+
+    def observe(self, *outputs: tp.Any) -> None:
+        """Block on the step's outputs; the wait is charged to `device`."""
+        if self._host_start is None or not outputs:
+            return
+        import jax
+        start = time.perf_counter()
+        jax.block_until_ready(outputs if len(outputs) != 1 else outputs[0])
+        if self._device_at is None:
+            self._device_at = start
+        self._device += time.perf_counter() - start
+
+    def finish(self) -> None:
+        """Close the final step; drop a dangling data segment (the
+        exhausted iterator's last `next()` produced no step)."""
+        self._close_step()
+        self._data_start = None
+
+    def _close_step(self) -> None:
+        if self._host_start is None:
+            return
+        now = time.perf_counter()
+        device = self._device
+        host = now - self._host_start - device + self._carry_overhead
+        io_start = time.perf_counter()
+        record = {"step": len(self.records), "data_wait": self._data_wait,
+                  "host": host, "device": device,
+                  "total": self._data_wait + host + device}
+        self.records.append(record)
+        if self.tracer is not None:
+            assert self._step_start is not None
+            start = self._step_start
+            self.tracer.complete("step/data_wait", start, self._data_wait,
+                                 category="step", stage=self.stage)
+            self.tracer.complete("step/host", self._host_start, host,
+                                 category="step", stage=self.stage)
+            if device > 0.0:
+                assert self._device_at is not None
+                self.tracer.complete("step/device", self._device_at, device,
+                                     category="step", stage=self.stage)
+            self.tracer.record({"type": "step", "stage": self.stage, **record})
+        if self.on_step is not None:
+            self.on_step(record)
+        self._carry_overhead = time.perf_counter() - io_start
+        self._host_start = None
+        self._step_start = None
+        self._data_wait = 0.0
+        self._device = 0.0
+        self._device_at = None
+
+    def summary(self) -> tp.Dict[str, float]:
+        """p50/p95/max step times + where the time went, for the stage
+        metrics dict (empty when no step completed)."""
+        if not self.records:
+            return {}
+        totals = [r["total"] for r in self.records]
+        out: tp.Dict[str, float] = {
+            "steps": float(len(self.records)),
+            "step_p50": _percentile(totals, 50),
+            "step_p95": _percentile(totals, 95),
+            "step_max": max(totals),
+        }
+        grand = sum(totals)
+        for key in ("data_wait", "host", "device"):
+            part = sum(r[key] for r in self.records)
+            out[f"{key}_frac"] = part / grand if grand > 0 else 0.0
+        return out

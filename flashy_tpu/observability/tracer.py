@@ -1,0 +1,176 @@
+# Host-side event tracing. The reference flashy has no profiler at all
+# (SURVEY §5: the per-stage `duration` metric is its only timing
+# signal); `jax.profiler.trace` (solver.enable_profiling) covers the
+# XLA/device side but says nothing about the host: data wait, python
+# overhead, checkpoint IO. The Tracer is the host-side complement — a
+# zero-dependency span recorder whose output loads straight into
+# Perfetto / chrome://tracing (the Chrome trace-event JSON format), plus
+# an append-only `telemetry.jsonl` journal of structured records (the
+# per-rank event journaling the Orbax paper motivates for multi-host
+# runs: a crash keeps every line written so far).
+"""Tracer: host-side spans -> Chrome/Perfetto trace + telemetry.jsonl."""
+from contextlib import contextmanager
+from pathlib import Path
+import functools
+import json
+import threading
+import time
+import typing as tp
+
+from ..utils import AnyPath, write_and_rename
+
+
+class Tracer:
+    """Records host-side monotonic events and exports them.
+
+    Spans nest naturally (the Chrome trace format infers nesting from
+    time containment within one pid/tid); loader worker threads get
+    their own tid lanes. All methods are thread-safe and cheap enough
+    to leave in hot loops (~a dict append under a lock).
+
+    Args:
+        trace_path: where `export_chrome_trace()` writes by default.
+        jsonl_path: the append-only journal; each `record()` call writes
+            one JSON line and flushes, so a killed run keeps every
+            record up to the crash.
+        rank: process index, stamped as the trace `pid` and into every
+            journal record.
+        max_events: in-memory event cap; past it new spans are counted
+            as dropped instead of recorded (the journal is unaffected).
+    """
+
+    def __init__(self, trace_path: tp.Optional[AnyPath] = None,
+                 jsonl_path: tp.Optional[AnyPath] = None,
+                 rank: int = 0, max_events: int = 200_000):
+        self.trace_path = Path(trace_path) if trace_path else None
+        self.jsonl_path = Path(jsonl_path) if jsonl_path else None
+        self.rank = rank
+        self.max_events = max_events
+        self.dropped = 0
+        self._events: tp.List[tp.Dict[str, tp.Any]] = []
+        self._lock = threading.Lock()
+        self._jsonl_file: tp.Optional[tp.IO[str]] = None
+        self._t0 = time.perf_counter()
+        self._add_meta("process_name", {"name": f"rank{rank}"})
+
+    # ------------------------------------------------------------------
+    # event recording
+    # ------------------------------------------------------------------
+    def _add_meta(self, name: str, args: tp.Dict[str, tp.Any]) -> None:
+        with self._lock:
+            self._events.append({"name": name, "ph": "M", "pid": self.rank,
+                                 "tid": threading.get_ident() % (1 << 31),
+                                 "args": args})
+
+    def _add(self, event: tp.Dict[str, tp.Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(event)
+
+    def complete(self, name: str, start: float, duration: float,
+                 category: str = "host", **args: tp.Any) -> None:
+        """Record a completed span from raw `time.perf_counter()` times.
+
+        For callers that measured a phase themselves (StepTimer) — the
+        span lands on the same clock as `span()` events.
+        """
+        self._add({"name": name, "cat": category, "ph": "X",
+                   "ts": (start - self._t0) * 1e6, "dur": duration * 1e6,
+                   "pid": self.rank, "tid": threading.get_ident() % (1 << 31),
+                   "args": args})
+
+    @contextmanager
+    def span(self, name: str, category: str = "host", **args: tp.Any):
+        """Context manager recording one complete ('X') event."""
+        start = time.perf_counter()
+        try:
+            yield self
+        finally:
+            duration = time.perf_counter() - start
+            self.complete(name, start, duration, category=category, **args)
+
+    def wrap(self, fn: tp.Optional[tp.Callable] = None, *,
+             name: tp.Optional[str] = None) -> tp.Callable:
+        """Decorator form of `span`: `@tracer.wrap` or `@tracer.wrap(name=...)`."""
+        if fn is None:
+            return functools.partial(self.wrap, name=name)
+
+        span_name = name or getattr(fn, "__qualname__", repr(fn))
+
+        @functools.wraps(fn)
+        def wrapped(*args: tp.Any, **kwargs: tp.Any) -> tp.Any:
+            with self.span(span_name):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    def instant(self, name: str, category: str = "host", **args: tp.Any) -> None:
+        """Record a zero-duration marker event."""
+        self._add({"name": name, "cat": category, "ph": "i", "s": "p",
+                   "ts": (time.perf_counter() - self._t0) * 1e6,
+                   "pid": self.rank, "tid": threading.get_ident() % (1 << 31),
+                   "args": args})
+
+    def counter(self, name: str, **values: float) -> None:
+        """Record a counter sample (rendered as a track in Perfetto)."""
+        self._add({"name": name, "ph": "C",
+                   "ts": (time.perf_counter() - self._t0) * 1e6,
+                   "pid": self.rank, "args": dict(values)})
+
+    @property
+    def events(self) -> tp.List[tp.Dict[str, tp.Any]]:
+        """Snapshot of the recorded trace events (tests, inspection)."""
+        with self._lock:
+            return list(self._events)
+
+    # ------------------------------------------------------------------
+    # journal
+    # ------------------------------------------------------------------
+    def record(self, record: tp.Dict[str, tp.Any]) -> None:
+        """Append one structured record to `telemetry.jsonl` (flushed).
+
+        `time` (unix seconds) and `rank` are stamped in; the caller owns
+        the rest of the schema (e.g. StepTimer's per-step records).
+        """
+        if self.jsonl_path is None:
+            return
+        line = json.dumps({"time": time.time(), "rank": self.rank, **record},
+                          default=float)
+        with self._lock:
+            if self._jsonl_file is None:
+                self.jsonl_path.parent.mkdir(parents=True, exist_ok=True)
+                self._jsonl_file = open(self.jsonl_path, "a")
+            self._jsonl_file.write(line + "\n")
+            self._jsonl_file.flush()
+
+    # ------------------------------------------------------------------
+    # export
+    # ------------------------------------------------------------------
+    def export_chrome_trace(self, path: tp.Optional[AnyPath] = None) -> Path:
+        """Write the Chrome trace-event JSON (atomic full rewrite).
+
+        Safe to call repeatedly (e.g. at every stage end): the file is
+        always a complete valid trace of everything recorded so far —
+        open it in https://ui.perfetto.dev or chrome://tracing.
+        """
+        target = Path(path) if path else self.trace_path
+        if target is None:
+            raise ValueError("no trace path: pass `path` or set `trace_path`")
+        payload = {"traceEvents": self.events, "displayTimeUnit": "ms"}
+        if self.dropped:
+            payload["metadata"] = {"dropped_events": self.dropped}
+        target.parent.mkdir(parents=True, exist_ok=True)
+        with write_and_rename(target, "w") as f:
+            json.dump(payload, f)
+        return target
+
+    def close(self) -> None:
+        """Export the trace (when a path is set) and close the journal."""
+        if self.trace_path is not None:
+            self.export_chrome_trace()
+        with self._lock:
+            if self._jsonl_file is not None:
+                self._jsonl_file.close()
+                self._jsonl_file = None

@@ -1,0 +1,108 @@
+# Recompile watchdog. Under jit, a shape/dtype/static-arg change does
+# not error — XLA silently traces and compiles a fresh executable,
+# turning a millisecond step into a multi-second one. On TPU this is
+# the single most common "training mysteriously 100x slower" cause
+# (unpadded final batch, python float vs weak-typed scalar, a config
+# read inside the step). The watchdog counts compilations per jitted
+# function via the jit cache size and, once a function recompiles after
+# warm-up, logs a WARNING naming it and the argument shapes that
+# triggered the new trace.
+"""RecompileWatchdog: WARN when a jitted function recompiles after warm-up."""
+import functools
+import logging
+import typing as tp
+
+from .tracer import Tracer
+
+logger = logging.getLogger(__name__)
+
+_MAX_LEAVES_SHOWN = 16
+
+
+def describe_abstract(args: tp.Any, kwargs: tp.Any) -> str:
+    """Compact shape/dtype description of a call's arguments — the same
+    information jit keys its cache on, so two calls with different
+    descriptions explain a recompile."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    parts = []
+    for leaf in leaves[:_MAX_LEAVES_SHOWN]:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{dtype}[{','.join(str(d) for d in shape)}]")
+        else:
+            parts.append(f"{type(leaf).__name__}({leaf!r})")
+    if len(leaves) > _MAX_LEAVES_SHOWN:
+        parts.append(f"... +{len(leaves) - _MAX_LEAVES_SHOWN} more leaves")
+    return ", ".join(parts)
+
+
+class RecompileWatchdog:
+    """Wraps jitted functions and watches their compile-cache growth.
+
+    `warmup` compiles per function are expected (the first trace; one
+    more for a train/eval shape pair fits `warmup=2`). Any compile past
+    that logs a WARNING with the function name and the offending
+    argument shapes, fires a tracer instant event, and is tallied in
+    `counts` so tests and the stage summary can assert on it.
+    """
+
+    def __init__(self, warmup: int = 1, tracer: tp.Optional[Tracer] = None,
+                 log: tp.Optional[logging.Logger] = None):
+        self.warmup = warmup
+        self.tracer = tracer
+        self._logger = log or logger
+        self.counts: tp.Dict[str, tp.Dict[str, int]] = {}
+
+    def watch(self, fn: tp.Callable, name: tp.Optional[str] = None,
+              warmup: tp.Optional[int] = None) -> tp.Callable:
+        """Return `fn` wrapped with recompile detection.
+
+        `fn` must be a `jax.jit`-wrapped callable (it exposes the
+        `_cache_size` hook the detection polls); wrapping a plain
+        python function raises immediately rather than silently never
+        warning.
+        """
+        cache_size = getattr(fn, "_cache_size", None)
+        if cache_size is None:
+            raise TypeError(
+                f"RecompileWatchdog.watch expects a jax.jit-wrapped "
+                f"function (got {fn!r} with no compile cache); wrap the "
+                f"jitted callable, not the python one.")
+        fn_name = name or getattr(fn, "__name__", None) or repr(fn)
+        allowed = self.warmup if warmup is None else warmup
+        entry = self.counts.setdefault(fn_name, {"calls": 0, "compiles": 0,
+                                                 "recompiles": 0})
+
+        @functools.wraps(fn)
+        def wrapped(*args: tp.Any, **kwargs: tp.Any) -> tp.Any:
+            before = cache_size()
+            out = fn(*args, **kwargs)
+            grew = cache_size() - before
+            entry["calls"] += 1
+            if grew > 0:
+                entry["compiles"] += grew
+                if entry["compiles"] > allowed:
+                    entry["recompiles"] += grew
+                    shapes = describe_abstract(args, kwargs)
+                    self._logger.warning(
+                        "recompile #%d of %r (after %d warm-up compiles) "
+                        "triggered by arguments: %s",
+                        entry["compiles"], fn_name, allowed, shapes)
+                    if self.tracer is not None:
+                        self.tracer.instant(f"recompile/{fn_name}",
+                                            category="watchdog", shapes=shapes)
+                        self.tracer.record({"type": "recompile", "fn": fn_name,
+                                            "compiles": entry["compiles"],
+                                            "shapes": shapes})
+            return out
+
+        wrapped.watchdog_name = fn_name  # type: ignore[attr-defined]
+        return wrapped
+
+    def summary(self) -> tp.Dict[str, int]:
+        """Total recompiles-past-warmup per watched function (nonzero only)."""
+        return {name: entry["recompiles"] for name, entry in self.counts.items()
+                if entry["recompiles"]}

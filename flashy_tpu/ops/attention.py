@@ -23,6 +23,8 @@ import typing as tp
 
 import jax
 import jax.numpy as jnp
+
+from .. import _compat
 import numpy as np
 
 NEG_INF = -1e30
@@ -300,7 +302,7 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
                                offset=t_k - t_q)
     # Inside shard_map the outputs vary over the same mesh axes as the
     # inputs; pallas_call requires that stated explicitly on out_shape.
-    vma = jax.typeof(q).vma
+    vma = _compat.vma_of(q)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -314,9 +316,10 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
             pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((batch * heads, t_q, dim), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((batch * heads, t_q, LANES), jnp.float32,
-                                 vma=vma),
+            _compat.shape_dtype_struct((batch * heads, t_q, dim), q.dtype,
+                                       vma=vma),
+            _compat.shape_dtype_struct((batch * heads, t_q, LANES),
+                                       jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),  # running max
@@ -357,14 +360,15 @@ def _flash_backward(q, k, v, out, lse, grad_out, *, causal: bool,
         pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),  # lse
         pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),  # D
     ]
-    vma = jax.typeof(q).vma
+    vma = _compat.vma_of(q)
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, offset=offset),
         grid=(bh, t_q // block_q, t_k // block_k),
         in_specs=row_specs,
         out_specs=pl.BlockSpec((1, block_q, dim), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q, dim), q.dtype, vma=vma),
+        out_shape=_compat.shape_dtype_struct((bh, t_q, dim), q.dtype,
+                                             vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, dim), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
@@ -387,8 +391,8 @@ def _flash_backward(q, k, v, out, lse, grad_out, *, causal: bool,
             pl.BlockSpec((1, block_k, dim), lambda b, ki, qi: (b, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t_k, dim), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, t_k, dim), v.dtype, vma=vma),
+            _compat.shape_dtype_struct((bh, t_k, dim), k.dtype, vma=vma),
+            _compat.shape_dtype_struct((bh, t_k, dim), v.dtype, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, dim), jnp.float32),

@@ -44,10 +44,77 @@ _PAYLOADLESS = {"token", "opaque"}
 # comments (which contain '='), so a character class excluding '='
 # silently skips exactly the biggest collectives.
 _INSTR_RE = re.compile(
-    r"=\s+(?P<shape>.*?)\s+(?P<op>%s)(?:-start)?\("
+    r"=\s+(?P<shape>.*?)\s+(?P<op>%s)(?P<start>-start)?\("
     % "|".join(COLLECTIVE_OPS))
 # dtype tokens interleave letters and digits (bf16, f8e4m3fn, c128)
 _SHAPE_RE = re.compile(r"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>[\d,]*)\]")
+
+
+def _split_top_level_tuple(shape_text: str) -> tp.Optional[tp.List[str]]:
+    """Elements of a top-level HLO tuple shape, or None for non-tuples.
+
+    Commas inside dimension lists (`f32[128,256]`), layout annotations
+    (`{1,0}`) and nested tuples are not separators; `/*index=N*/`
+    comments are left in place (the shape regex ignores them).
+    """
+    text = shape_text.strip()
+    if not text.startswith("(") or not text.endswith(")"):
+        return None
+    depth = 0
+    elements, current = [], []
+    for ch in text[1:-1]:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            elements.append("".join(current))
+            current = []
+        else:
+            current.append(ch)
+    elements.append("".join(current))
+    return [e for e in (e.strip() for e in elements) if e]
+
+
+# async-start context/scratch tuple elements (e.g. the two u32[] of a
+# collective-permute-start) — sync-flag scalars that TRAIL the
+# operand/result aliases in the output tuple.
+_CONTEXT_RE = re.compile(r"^[su]32\[\]")
+
+# `-start` ops whose output tuple PREPENDS the input-shaped operand
+# alias(es) to the result(s). all-reduce-start is deliberately absent:
+# its (possibly variadic tuple) output holds results only, so the full
+# tuple is already the sync-equivalent byte count.
+_OPERAND_ALIASING_STARTS = {"all-gather", "collective-permute"}
+
+
+def _async_start_bytes(op: str, shape_text: str) -> tp.Optional[int]:
+    """Result-only bytes of an async `-start` instruction's output tuple.
+
+    For `op` in `_OPERAND_ALIASING_STARTS` the output tuple aliases the
+    input-shaped operand(s) ahead of the result(s) (plus scalar
+    context/scratch words): counting the whole tuple roughly doubles
+    the byte total vs the same program lowered to sync ops. Convention
+    (documented on `collective_stats`): drop trailing scalar u32/s32
+    context elements, then count only the second half of the remaining
+    data elements — the results. Returns None for non-tuple outputs and for
+    ops without operand aliasing (there the plain shape / full tuple IS
+    the result set, as in sync).
+    """
+    if op not in _OPERAND_ALIASING_STARTS:
+        return None
+    elements = _split_top_level_tuple(shape_text)
+    if elements is None:
+        return None
+    # Context words are indistinguishable from a genuinely scalar
+    # u32/s32 payload by shape alone, so position disambiguates: strip
+    # them only from the TAIL, and never below the two elements an
+    # operand-aliasing start always keeps (operand alias + result) —
+    # a scalar-counter ppermute must count 4 bytes, same as sync.
+    data = list(elements)
+    while len(data) > 2 and _CONTEXT_RE.match(data[-1]):
+        data.pop()
+    return sum(_shape_bytes(e) for e in data[len(data) // 2:])
 
 
 def _shape_bytes(shape_text: str) -> int:
@@ -83,7 +150,11 @@ def collective_stats(compiled: tp.Any) -> tp.Dict[str, tp.Dict[str, int]]:
     OUTPUT shape summed over the program — a device-count-independent
     proxy for traffic that is exactly what regresses when a sharding
     spec silently falls back to replication. Async `-start`/`-done`
-    pairs are counted once.
+    pairs are counted once, and bytes follow the SYNC convention: a
+    `-start` output tuple embeds the input-shaped operand(s) before the
+    result(s), so only the result element(s) are counted — the same
+    program reports the same bytes whether XLA lowered its collectives
+    sync (CPU) or async (TPU).
     """
     text = compiled if isinstance(compiled, str) else compiled.as_text()
     stats = {op: {"count": 0, "bytes": 0} for op in COLLECTIVE_OPS}
@@ -93,7 +164,12 @@ def collective_stats(compiled: tp.Any) -> tp.Dict[str, tp.Dict[str, int]]:
             continue
         entry = stats[m.group("op")]
         entry["count"] += 1
-        entry["bytes"] += _shape_bytes(m.group("shape"))
+        size = None
+        if m.group("start"):
+            size = _async_start_bytes(m.group("op"), m.group("shape"))
+        if size is None:
+            size = _shape_bytes(m.group("shape"))
+        entry["bytes"] += size
     return stats
 
 

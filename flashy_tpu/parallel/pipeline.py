@@ -12,6 +12,8 @@ import typing as tp
 
 import jax
 import jax.numpy as jnp
+
+from .. import _compat
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -31,7 +33,7 @@ def _stage_body(stage_fn, params, x_micro, axis, num_stages, num_micro,
     # The input is replicated over the pipe axis but everything computed
     # from the (stage-varying) params is device-varying; mark the whole
     # dataflow varying up front so the scan carry types are stable.
-    x_micro = jax.lax.pcast(x_micro, (axis,), to="varying")
+    x_micro = _compat.pcast_varying(x_micro, (axis,))
     zero = jnp.zeros_like(x_micro[0])
     outputs0 = jnp.zeros_like(x_micro)
 
@@ -63,7 +65,7 @@ def _stage_body(stage_fn, params, x_micro, axis, num_stages, num_micro,
         incoming = jax.lax.ppermute(y, axis, perm)
         return (incoming, outputs, aux_sum), None
 
-    aux0 = jax.lax.pcast(jnp.zeros(()), (axis,), to="varying")
+    aux0 = _compat.pcast_varying(jnp.zeros(()), (axis,))
     (_, outputs, aux_sum), _ = jax.lax.scan(
         tick, (zero, outputs0, aux0), jnp.arange(ticks))
     return outputs[None], aux_sum[None]  # leading stage dim for P(axis)
@@ -112,12 +114,17 @@ def pipeline(stage_fn: tp.Callable, stage_params: tp.Any, x: jax.Array, *,
     # params sharded on their stacked leading dim; input replicated over
     # 'pipe'. Output comes back stacked over stages; the last stage's
     # slice is the pipeline result, the aux scalars sum over stages.
-    out_stacked, aux_stacked = jax.shard_map(
+    # check_vma only on jax with the vma type system: the legacy
+    # check_rep analysis false-positives on this schedule's cond
+    # branches ("mismatched replication types" — the exact case jax's
+    # own error message says to work around with check_rep=False).
+    out_stacked, aux_stacked = _compat.shard_map(
         lambda params, xm: body(
             stage_fn, jax.tree_util.tree_map(lambda p: p[0], params), xm),
         mesh=mesh,
         in_specs=(P(axis), P()),
         out_specs=(P(axis), P(axis)),
+        check_vma=_compat.HAS_VMA,
     )(stage_params, x_micro)
     out = out_stacked[-1]  # [M, mb, ...] from the final stage
     out = out.reshape(batch, *x.shape[1:])

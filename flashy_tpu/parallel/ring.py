@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import _compat
 from ..ops import attention as _attn
 
 NEG_INF = -1e30
@@ -150,13 +151,13 @@ def _mark_varying(tree, like):
     """Make every leaf device-varying on the axes `like` varies over —
     scan carries need stable varying types, and block outputs computed
     purely from replicated inputs would otherwise come back invariant."""
-    target = set(jax.typeof(like).vma)
+    target = set(_compat.vma_of(like))
     if not target:
         return tree
 
     def mark(x):
-        missing = tuple(target - set(jax.typeof(x).vma))
-        return jax.lax.pcast(x, missing, to="varying") if missing else x
+        missing = tuple(target - set(_compat.vma_of(x)))
+        return _compat.pcast_varying(x, missing)
 
     return jax.tree_util.tree_map(mark, tree)
 
@@ -386,5 +387,5 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     # The vma checker is a tracer-level lint; numerics are unaffected.
     # tools/tpu_validate.py probes check_vma=True on the real backend
     # and records whether the strict check lowers there.
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=check_vma)(q, k, v)
+    return _compat.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=check_vma)(q, k, v)

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import _compat
 from ..ops import attention as _attn
 from . import ring as _ring
 
@@ -250,7 +251,7 @@ def _fused_forward(q, k, v, axis_name: str, mesh_axes, causal: bool,
         _fused_kernel, axis_name=axis_name, mesh_axes=mesh_axes,
         causal=causal,
         block_q=block_q, n_steps=n_steps, bh=bh, n_q=n_q, t_loc=t_loc)
-    vma = jax.typeof(q).vma
+    vma = _compat.vma_of(q)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_steps),
@@ -270,10 +271,13 @@ def _fused_forward(q, k, v, axis_name: str, mesh_axes, causal: bool,
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t_loc, dim), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, t_loc, LANES), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((n_steps, bh, t_loc, dim), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((n_steps, bh, t_loc, dim), v.dtype, vma=vma),
+            _compat.shape_dtype_struct((bh, t_loc, dim), q.dtype, vma=vma),
+            _compat.shape_dtype_struct((bh, t_loc, LANES), jnp.float32,
+                                       vma=vma),
+            _compat.shape_dtype_struct((n_steps, bh, t_loc, dim), k.dtype,
+                                       vma=vma),
+            _compat.shape_dtype_struct((n_steps, bh, t_loc, dim), v.dtype,
+                                       vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((t_loc, dim), k.dtype),              # K tile

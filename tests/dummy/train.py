@@ -96,12 +96,19 @@ class Solver(flashy_tpu.BaseSolver):
             else:
                 mse = jnp.mean((fake - real) ** 2)
                 step_metrics = {"mse": mse}
+            # bound device time: the blocking wait here is charged to
+            # `device`, keeping it out of the averager's host time
+            # (no-op when telemetry is off)
+            progress.observe(self.state, step_metrics)
             metrics = average(step_metrics)
             progress.update(**metrics)
         return distrib.average_metrics(metrics, len(self.loader))
 
     def run(self):
         self.logger.info("Log dir: %s", self.folder)
+        if self.cfg.get("telemetry"):
+            telemetry = self.enable_telemetry()
+            self._gen_step = telemetry.watch(self._gen_step, name="gen_step")
         self.restore()
         for epoch in range(self.epoch, self.cfg.epochs + 1):
             self.run_stage("train", self.do_train_valid, train=True)

@@ -187,7 +187,9 @@ def test_hlo_parser_handles_tuples_async_and_comments():
         # tuple all-reduce with index comments: 64*4 + 64*4 + 4 bytes
         "%all-reduce.24 = (f32[64]{0}, /*index=1*/f32[64]{0}, "
         "/*index=2*/f32[]) all-reduce(%a, %b, %c), channel_id=1",
-        # async pair: only the -start counts
+        # async pair: only the -start counts, and only its RESULT tuple
+        # element (f32[64,16]) — the f32[8,16] operand alias would double
+        # the bytes vs the sync lowering of the same program
         "%ag = (f32[8,16]{1,0}, f32[64,16]{1,0}) "
         "all-gather-start(%x), channel_id=2",
         "%ag.1 = f32[64,16]{1,0} all-gather-done(%ag)",
@@ -205,7 +207,7 @@ def test_hlo_parser_handles_tuples_async_and_comments():
     assert stats["all-reduce"] == {"count": 2,
                                    "bytes": 64 * 4 * 2 + 4 + 64}
     assert stats["all-gather"] == {"count": 2,
-                                   "bytes": (8 * 16 + 64 * 16) * 4 + 64}
+                                   "bytes": 64 * 16 * 4 + 64}
     assert stats["collective-permute"] == {"count": 1, "bytes": 4 * 32 * 2}
     assert stats["ragged-all-to-all"] == {"count": 1, "bytes": 8 * 16 * 4}
     assert stats["all-to-all"]["count"] == 0
@@ -213,6 +215,88 @@ def test_hlo_parser_handles_tuples_async_and_comments():
     # unknown dtypes are LOUD, not silently zero
     with pytest.raises(ValueError, match="unknown HLO dtype"):
         collective_stats("%x = q9[64]{0} all-reduce(%a), channel_id=1")
+
+
+def test_async_start_counts_match_sync_lowering():
+    """The bytes convention is sync-equivalent: for `-start` forms only
+    the result element(s) of the output tuple count (ADVICE round 5 —
+    the operand alias in the tuple used to double the total), so byte
+    assertions calibrated on CPU (sync) hold on TPU (async)."""
+    from flashy_tpu.parallel.accounting import collective_stats
+
+    sync = collective_stats(
+        "%ag = f32[64,16]{1,0} all-gather(%x), channel_id=1\n"
+        "%cp = bf16[4,32]{1,0} collective-permute(%y), channel_id=2\n")
+    async_ = collective_stats(
+        # all-gather-start: (operand, result)
+        "%ag = (f32[8,16]{1,0}, f32[64,16]{1,0}) "
+        "all-gather-start(%x), channel_id=1\n"
+        "%agd = f32[64,16]{1,0} all-gather-done(%ag)\n"
+        # collective-permute-start: (operand, result, context scratch)
+        "%cp = (bf16[4,32]{1,0}, bf16[4,32]{1,0}, u32[], u32[]) "
+        "collective-permute-start(%y), channel_id=2\n"
+        "%cpd = bf16[4,32]{1,0} collective-permute-done(%cp)\n")
+    for op in ("all-gather", "collective-permute"):
+        assert async_[op] == sync[op], op
+
+    # non-tuple -start output (async all-reduce keeps the plain result
+    # shape): counted exactly like the sync form
+    sync_ar = collective_stats("%ar = f32[64]{0} all-reduce(%a), channel_id=3")
+    async_ar = collective_stats(
+        "%ar = f32[64]{0} all-reduce-start(%a), channel_id=3\n"
+        "%ard = f32[64]{0} all-reduce-done(%ar)")
+    assert async_ar["all-reduce"] == sync_ar["all-reduce"]
+
+    # variadic all-reduce-start: the output tuple holds RESULTS ONLY
+    # (no operand aliases, unlike all-gather-start) — count all of it
+    sync_var = collective_stats(
+        "%ar = (f32[64]{0}, f32[32]{0}) all-reduce(%a, %b), channel_id=4")
+    async_var = collective_stats(
+        "%ar = (f32[64]{0}, f32[32]{0}) all-reduce-start(%a, %b), channel_id=4\n"
+        "%ard = (f32[64]{0}, f32[32]{0}) all-reduce-done(%ar)")
+    assert async_var["all-reduce"] == sync_var["all-reduce"]
+    assert async_var["all-reduce"]["bytes"] == (64 + 32) * 4
+
+
+def test_scalar_payload_async_start_counts_like_sync():
+    """collective-permute of a scalar s32 counter: every element of the
+    async output tuple is a 32-bit scalar, so shape alone cannot tell
+    payload from context — position (context words trail) plus the
+    operand+result floor must keep the 4 payload bytes, matching the
+    sync lowering instead of reporting 0."""
+    from flashy_tpu.parallel.accounting import collective_stats
+
+    sync = collective_stats(
+        "%cp = s32[] collective-permute(%y), channel_id=2")
+    async_ = collective_stats(
+        "%cp = (s32[], s32[], u32[], u32[]) "
+        "collective-permute-start(%y), channel_id=2\n"
+        "%cpd = s32[] collective-permute-done(%cp)")
+    assert async_["collective-permute"] == sync["collective-permute"]
+    assert async_["collective-permute"]["bytes"] == 4
+
+
+def test_tuple_splitter_handles_layout_braces():
+    # commas inside layout annotations {1,0} must not split elements:
+    # a mixed-rank async tuple would otherwise fragment and count 0
+    from flashy_tpu.parallel.accounting import _split_top_level_tuple
+
+    assert _split_top_level_tuple(
+        "(f32[8,16]{1,0}, f32[64,16]{1,0})") == [
+            "f32[8,16]{1,0}", "f32[64,16]{1,0}"]
+    assert _split_top_level_tuple("f32[8,16]{1,0}") is None
+
+
+def test_multi_operand_async_start_counts_results_only():
+    """Variadic all-gather-start: (in1, in2, out1, out2) -> only the two
+    output elements count."""
+    from flashy_tpu.parallel.accounting import collective_stats
+
+    stats = collective_stats(
+        "%ag = (f32[8,16]{1,0}, bf16[8,16]{1,0}, /*index=2*/f32[64,16]{1,0}, "
+        "/*index=3*/bf16[64,16]{1,0}) all-gather-start(%x, %y), channel_id=7")
+    assert stats["all-gather"] == {"count": 1,
+                                   "bytes": 64 * 16 * 4 + 64 * 16 * 2}
 
 
 @pytest.mark.slow

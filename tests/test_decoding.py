@@ -214,6 +214,23 @@ def test_nucleus_filter_keeps_smallest_top_mass_prefix():
     assert (out[1] > -1e29).sum() == 4
 
 
+def test_nucleus_filter_rejects_out_of_range_top_p():
+    # top_p <= 0 used to mask EVERY logit to -1e30 (near-uniform
+    # sampling), contradicting the argmax-always-survives contract —
+    # concrete out-of-range values are rejected loudly instead.
+    from flashy_tpu.models.decoding import nucleus_filter
+
+    logits = jnp.asarray(np.log(np.array([[0.5, 0.3, 0.15, 0.05]])),
+                         jnp.float32)
+    for bad in (0.0, -0.5, 1.5, np.float32(0.0), np.float64(1.5)):
+        with pytest.raises(ValueError, match="top_p"):
+            nucleus_filter(logits, bad)
+    # a traced top_p can't be range-checked, but the argmax still
+    # survives by construction
+    out = np.asarray(jax.jit(nucleus_filter)(logits, jnp.float32(0.0)))[0]
+    assert set(np.nonzero(out > -1e29)[0].tolist()) == {0}
+
+
 def test_generate_with_top_p_stays_in_nucleus():
     # near-deterministic logits via a rigged vocab-64 distribution is
     # impractical on a random-init model, so assert the API contract:

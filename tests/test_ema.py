@@ -86,8 +86,39 @@ def test_ema_solver_checkpoint_roundtrip(tmp_path):
 
         s2 = S2()
         s2.load_state_dict(state)
-        assert s2.ema.decay == 0.5
+        # the live config's decay wins over the checkpointed one (ADVICE
+        # round 5: resuming after a config change must take effect) —
+        # the shadow values themselves come from the checkpoint
+        assert s2.ema.decay == 0.9
         np.testing.assert_allclose(np.asarray(s2.ema.shadow["w"]), 0.5)
+
+
+def test_ema_restore_decay_mismatch_warns(caplog):
+    import logging
+
+    ema = EMA({"w": jnp.zeros((2,))}, decay=0.999)
+    state = EMA({"w": jnp.ones((2,))}, decay=0.5).state_dict()
+    with caplog.at_level(logging.WARNING, logger="flashy_tpu.ema"):
+        ema.load_state_dict(state)
+    assert any("decay mismatch" in r.message for r in caplog.records)
+    assert ema.decay == 0.999  # live config kept
+    # same decay -> silent
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="flashy_tpu.ema"):
+        ema.load_state_dict(EMA({"w": jnp.ones((2,))}, decay=0.999).state_dict())
+    assert not caplog.records
+
+
+def test_ema_restore_rejects_shape_mismatch():
+    ema = EMA({"w": jnp.zeros((2, 3))})
+    bad = EMA({"w": jnp.zeros((4, 3))}).state_dict()
+    with pytest.raises(ValueError, match="shapes differ"):
+        ema.load_state_dict(bad)
+
+    # leaf-count mismatch (model structure changed) is also loud
+    bad_count = EMA({"w": jnp.zeros((2, 3)), "b": jnp.zeros(3)}).state_dict()
+    with pytest.raises(ValueError, match="leaves"):
+        ema.load_state_dict(bad_count)
 
 
 def test_ema_sharded_update_no_collectives():

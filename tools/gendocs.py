@@ -12,6 +12,7 @@ import importlib
 import inspect
 import pkgutil
 import sys
+import typing as tp
 from pathlib import Path
 
 # Runnable from a source checkout without installation.
@@ -121,11 +122,17 @@ def iter_modules(package_name: str):
             print(f"skip {info.name}: {exc}", file=sys.stderr)
 
 
-def main() -> None:
+def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("-o", "--output", default="docs/api")
     parser.add_argument("-p", "--package", default="flashy_tpu")
-    args = parser.parse_args()
+    parser.add_argument("-c", "--check", action="append", default=[],
+                        metavar="MODULE",
+                        help="fail (exit 1) unless a page was generated for "
+                             "this module — guards against a subpackage "
+                             "silently dropping out of the docs because its "
+                             "import started failing (repeatable)")
+    args = parser.parse_args(argv)
 
     out = Path(args.output)
     out.mkdir(parents=True, exist_ok=True)
@@ -146,6 +153,14 @@ def main() -> None:
     (out / "index.html").write_text(index)
     print("wrote", out / "index.html")
 
+    documented = {name for name, _, _ in entries}
+    missing = [name for name in args.check if name not in documented]
+    if missing:
+        print("ERROR: no documentation generated for: "
+              + ", ".join(missing), file=sys.stderr)
+        return 1
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())
