@@ -23,8 +23,16 @@ coverage:
 bench:
 	python bench.py
 
+# Continuous-batching serving smoke demo on CPU: 32 staggered requests
+# through an 8-slot engine, outputs verified token-exact against
+# per-request generate(), zero post-warm-up recompiles (exit 1 on any
+# violation). A couple of minutes; also run by the tests workflow.
+serve-demo:
+	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --requests 32 --slots 8
+
 docs:
-	python tools/gendocs.py -o docs/api -p flashy_tpu
+	python tools/gendocs.py -o docs/api -p flashy_tpu \
+		-c 'flashy_tpu.observability*' -c 'flashy_tpu.serve*'
 
 native:
 	python tools/build_native.py
@@ -32,4 +40,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all coverage bench docs native dist
+.PHONY: default linter tests tests-all coverage bench serve-demo docs native dist

@@ -36,3 +36,4 @@ from .solver import BaseSolver  # noqa
 from .utils import averager  # noqa
 from .ema import EMA, ema_update  # noqa
 from .xp import get_xp, main  # noqa
+from . import serve  # noqa — continuous-batching inference serving

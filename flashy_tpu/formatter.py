@@ -15,7 +15,10 @@ class Formatter:
     returns the relevant subset, formatted as strings.
 
     Args:
-        formats: mapping pattern -> format spec (as given to `format()`).
+        formats: mapping pattern -> format spec (as given to `format()`)
+            OR a callable `value -> str` for renderings a format spec
+            cannot express (percentages, unit suffixes — the serving
+            metrics use this, see `flashy_tpu.logging.serve_formatter`).
             The first matching pattern wins.
         default_format: spec applied to metrics matching no pattern.
         exclude_keys: patterns to hide. If only `exclude_keys` is given
@@ -66,4 +69,8 @@ class Formatter:
 
     def __call__(self, metrics: dict) -> tp.Dict[str, str]:
         relevant = self.get_relevant_metrics(metrics)
-        return {k: format(v, self._format_spec(k)) for k, v in relevant.items()}
+        out = {}
+        for k, v in relevant.items():
+            spec = self._format_spec(k)
+            out[k] = str(spec(v)) if callable(spec) else format(v, spec)
+        return out

@@ -8,9 +8,9 @@ from pathlib import Path
 
 
 def collect(root: Path):
-    """Yield {sig, cfg, argv, history, telemetry} for every XP under root."""
+    """Yield {sig, cfg, argv, history, telemetry, serve} per XP under root."""
     from .xp import (CONFIG_SNAPSHOT_NAME, HEARTBEAT_DIR_NAME, RUN_INFO_NAME,
-                     Link)
+                     SERVE_STATUS_NAME, Link)
     from .observability import straggler_report
 
     xps_dir = root / "xps"
@@ -20,7 +20,7 @@ def collect(root: Path):
         if not folder.is_dir():
             continue
         entry = {"sig": folder.name, "cfg": {}, "argv": [], "history": [],
-                 "telemetry": {}}
+                 "telemetry": {}, "serve": {}}
         config_path = folder / CONFIG_SNAPSHOT_NAME
         if config_path.exists():
             with open(config_path) as f:
@@ -33,6 +33,10 @@ def collect(root: Path):
         heartbeat_dir = folder / HEARTBEAT_DIR_NAME
         if heartbeat_dir.is_dir():
             entry["telemetry"] = straggler_report(heartbeat_dir)
+        serve_path = folder / SERVE_STATUS_NAME
+        if serve_path.exists():
+            with open(serve_path) as f:
+                entry["serve"] = json.load(f)
         yield entry
 
 
@@ -55,9 +59,30 @@ def format_entry(entry, verbose: bool = False) -> str:
     if entry.get("telemetry", {}).get("ranks"):
         from .observability import format_straggler_report
         line += "\n  heartbeats: " + format_straggler_report(entry["telemetry"])
+    if entry.get("serve"):
+        line += "\n  serve: " + format_serve_status(entry["serve"])
     if verbose:
         line += "\n  cfg: " + json.dumps(entry["cfg"], default=str)[:500]
     return line
+
+
+def format_serve_status(status: dict) -> str:
+    """One-line view of a `serve.json` snapshot (flashy_tpu.serve).
+
+    Shows the operator headline numbers — request tallies, TTFT and
+    inter-token latency p50/p95, occupancy — and ignores keys it does
+    not know, so the snapshot schema can grow without breaking info.
+    """
+    parts = []
+    for key in ("requests", "completed", "rejected"):
+        if key in status:
+            parts.append(f"{key}={int(status[key])}")
+    for key in ("ttft_ms_p50", "ttft_ms_p95", "itl_ms_p50", "itl_ms_p95"):
+        if key in status:
+            parts.append(f"{key}={status[key]:.1f}")
+    if "occupancy_p50" in status:
+        parts.append(f"occupancy_p50={status['occupancy_p50'] * 100:.0f}%")
+    return "  ".join(parts) or "(empty serve.json)"
 
 
 def format_device_stats() -> str:

@@ -156,6 +156,26 @@ def _moe_forward(cfg: TransformerConfig, mp: tp.Dict, x: jax.Array) -> jax.Array
     return out.reshape(batch, seq, dim).astype(cfg.dtype)
 
 
+def _cache_write(cache: jax.Array, new: jax.Array,
+                 cache_index: jax.Array) -> jax.Array:
+    """Write `new` [B, S, H, Dh] into `cache` at `cache_index`.
+
+    A scalar index writes the same offset for every row (the batched
+    `generate()` path: one shared decode position). A [B] vector writes
+    each row at its own offset — the serving path, where every slot of
+    the shared cache sits at a different sequence length. Out-of-range
+    rows (a retired slot parked at max_len) are dropped, not clamped:
+    a clamp would silently overwrite the last real position.
+    """
+    if jnp.ndim(cache_index) == 0:
+        return jax.lax.dynamic_update_slice(
+            cache, new, (0, cache_index, 0, 0))
+    batch, seq = new.shape[:2]
+    rows = jnp.arange(batch)[:, None]
+    cols = cache_index[:, None] + jnp.arange(seq)[None, :]
+    return cache.at[rows, cols].set(new, mode="drop")
+
+
 def _cached_self_attention(cfg, bp: tp.Dict, x: jax.Array,
                            positions: jax.Array, k_cache: jax.Array,
                            v_cache: jax.Array, cache_index: jax.Array):
@@ -164,17 +184,19 @@ def _cached_self_attention(cfg, bp: tp.Dict, x: jax.Array,
     Returns (x + attn_out, k_cache, v_cache). `cfg` only needs
     `.dtype`/`.head_dim`, so the seq2seq decoder shares this body (and
     its quantized-kernel support) — ONE implementation of the cache
-    update + causal-prefix mask recipe."""
+    update + causal-prefix mask recipe. `cache_index` is a scalar (all
+    rows at the same length) or a [B] vector (per-slot lengths, the
+    serving engine); the causal mask is per-row either way because it
+    derives from `positions`, so rows at different lengths attend only
+    their own live prefix."""
     normed = _rmsnorm(x, bp["norm1"]["scale"], cfg.dtype)
     qkv_w, qkv_s = _kernel(bp["attn"]["qkv"]["kernel"], cfg.dtype)
     qkv = _postscale(jnp.einsum("btd,dchk->btchk", normed, qkv_w), qkv_s)
     q, k, v = _split_heads(qkv)
     q = _rotary(q, positions)
     k = _rotary(k, positions)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(cfg.dtype), (0, cache_index, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(cfg.dtype), (0, cache_index, 0, 0))
+    k_cache = _cache_write(k_cache, k.astype(cfg.dtype), cache_index)
+    v_cache = _cache_write(v_cache, v.astype(cfg.dtype), cache_index)
 
     # Attend over the cache prefix [0, cache_index + seq).
     max_len = k_cache.shape[1]
@@ -308,6 +330,7 @@ def nucleus_filter(logits: jax.Array, top_p: float) -> jax.Array:
 def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: tp.Optional[int] = None,
              top_p: tp.Optional[float] = None,
+             eos_token: tp.Optional[int] = None,
              rng: tp.Optional[jax.Array] = None) -> jax.Array:
     """Autoregressive generation with a KV cache.
 
@@ -324,7 +347,15 @@ def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
             tokens whose cumulative probability reaches `top_p` (the
             most likely token always stays eligible). Composes with
             top_k (applied first).
-        rng: PRNG key (required when temperature > 0).
+        eos_token: when set, a row that emits this token is *done*:
+            every subsequent token of that row is pinned to `eos_token`
+            inside the scan (mask-based, shapes stay static — the scan
+            still runs `max_new_tokens` steps). The serving engine
+            (`flashy_tpu.serve`) reuses the same emitted-EOS convention
+            for slot retirement.
+        rng: PRNG key — required when temperature > 0 (sampling without
+            an explicit key would silently reuse PRNGKey(0) across
+            calls); greedy decoding needs no key.
 
     Returns [B, P + max_new_tokens] tokens. Jit-compatible: shapes are
     static in P and max_new_tokens.
@@ -337,6 +368,21 @@ def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
             "generate() implements causal KV-cache decoding; a "
             "config.causal=False (bidirectional/encoder) model has no "
             "autoregressive decode.")
+    if rng is None:
+        # float() concretizes python/numpy scalars AND concrete 0-d jax
+        # arrays; only a traced temperature escapes the check (and the
+        # `temperature <= 0` python branch in sample() rejects traced
+        # values loudly anyway).
+        try:
+            concrete_temp = float(temperature)
+        except (TypeError, jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            concrete_temp = None
+        if concrete_temp is not None and concrete_temp > 0.0:
+            raise ValueError(
+                "generate(temperature>0) samples and needs an explicit "
+                "`rng` key; pass rng=jax.random.PRNGKey(...) (greedy "
+                "temperature=0 decoding needs no key).")
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > cfg.max_seq_len:
@@ -351,6 +397,7 @@ def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
     last_logits = logits[:, -1]
 
     if rng is None:
+        # greedy path (validated above): the key is split, never consulted
         rng = jax.random.PRNGKey(0)
 
     def sample(logits, key):
@@ -365,14 +412,21 @@ def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     def step(carry, t):
-        last_logits, cache, key = carry
+        last_logits, cache, key, done = carry
         key, sub = jax.random.split(key)
         token = sample(last_logits, sub)
+        if eos_token is not None:
+            # rows already done keep emitting EOS; the row that samples
+            # EOS right now emits it (it IS the terminator) and is done
+            # from the next step on.
+            token = jnp.where(done, jnp.int32(eos_token), token)
+            done = done | (token == eos_token)
         position = jnp.broadcast_to(prompt_len + t, (batch, 1)).astype(jnp.int32)
         logits, cache = _apply_step(model, params, cfg, token[:, None],
                                     position, cache, prompt_len + t)
-        return (logits[:, -1], cache, key), token
+        return (logits[:, -1], cache, key, done), token
 
-    (_, _, _), tokens = jax.lax.scan(
-        step, (last_logits, cache, rng), jnp.arange(max_new_tokens))
+    done0 = jnp.zeros((batch,), bool)
+    (_, _, _, _), tokens = jax.lax.scan(
+        step, (last_logits, cache, rng, done0), jnp.arange(max_new_tokens))
     return jnp.concatenate([prompt, tokens.T.astype(prompt.dtype)], axis=1)

@@ -12,6 +12,13 @@
 # device-op timeline): profiling answers "what is the device doing",
 # telemetry answers "why is the step slower than the device time".
 #
+# The serving layer (flashy_tpu.serve) reports through the same pipe:
+# its CompileCache wraps every bucketed executable in the
+# RecompileWatchdog, and its metrics surface emits "serve" category
+# spans (serve/prefill, serve/decode), counter tracks
+# (serve/queue_depth, serve/slot_occupancy) and serve_summary journal
+# records via the Tracer.
+#
 # This module must stay importable with no accelerator present and must
 # not initialize a JAX backend at import time (tests enforce it): jax
 # is only imported inside functions that genuinely touch devices.

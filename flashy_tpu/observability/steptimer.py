@@ -14,13 +14,9 @@ import typing as tp
 from .tracer import Tracer
 
 
-def _percentile(values: tp.Sequence[float], q: float) -> float:
-    """Nearest-rank percentile without numpy (values need not be sorted)."""
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    index = min(len(ordered) - 1, max(0, int(round(q / 100.0 * (len(ordered) - 1)))))
-    return ordered[index]
+# one shared percentile (linear interpolation, numpy semantics) so a
+# p95 means the same thing here and on the serving metrics surface
+from ..utils import percentile as _percentile
 
 
 class StepTimer:

@@ -73,6 +73,16 @@ class Telemetry:
     def record(self, record: tp.Dict[str, tp.Any]) -> None:
         self.tracer.record(record)
 
+    def counter(self, name: str, **values: float) -> None:
+        """Sample a Perfetto counter track (e.g. the serving layer's
+        `serve/queue_depth` and `serve/slot_occupancy` gauges)."""
+        self.tracer.counter(name, **values)
+
+    def instant(self, name: str, category: str = "host",
+                **args: tp.Any) -> None:
+        """Drop a zero-duration marker (compile-cache misses, retirements)."""
+        self.tracer.instant(name, category=category, **args)
+
     def watch(self, fn: tp.Callable, name: tp.Optional[str] = None,
               warmup: tp.Optional[int] = None) -> tp.Callable:
         """Wrap a jitted function with recompile detection."""

@@ -1,0 +1,39 @@
+# Continuous-batching inference serving — the request-level layer on
+# top of models/decoding.py. The training side of this repo already
+# compiles one step function and reuses it for a whole run; serving
+# gets the same compiler-first discipline: a fixed-capacity KV cache
+# partitioned into S per-request slots, ONE compiled [S, 1] decode step
+# that runs whatever mix of slots is live (liveness is an input mask,
+# never a shape), prompt prefill bucketed to powers of two so the
+# entire serving lifetime touches a small pre-warmed set of
+# executables, and a FIFO continuous-batching scheduler that retires
+# requests on EOS/length and refills freed slots while decode keeps
+# streaming. Pieces:
+#
+#  * DecodeEngine / SlotAllocator   slot cache + compiled steps (engine)
+#  * ContinuousBatchingScheduler    queue, admission, retirement
+#  * CompileCache / bucket_length   per-bucket executables, hit/miss +
+#                                   recompile accounting via the PR 1
+#                                   RecompileWatchdog
+#  * ServeMetrics                   TTFT / ITL / queue / occupancy
+#                                   p50-p95 -> Tracer + ResultLogger +
+#                                   serve.json (flashy_tpu.info)
+#
+# `python -m flashy_tpu.serve` runs a CPU smoke demo: staggered
+# requests through an 8-slot engine, outputs verified token-exact
+# against per-request generate(), zero post-warm-up recompiles.
+"""Continuous-batching serving: slot KV cache + bucketed compile cache."""
+
+from .compile_cache import CompileCache, bucket_length  # noqa
+from .engine import DecodeEngine, SlotAllocator, SPAN_DECODE, SPAN_PREFILL  # noqa
+from .metrics import (  # noqa
+    ServeMetrics, percentile, COUNTER_QUEUE, COUNTER_OCCUPANCY,
+)
+from .scheduler import ContinuousBatchingScheduler, QueueFull, Request  # noqa
+
+__all__ = [
+    "DecodeEngine", "SlotAllocator", "ContinuousBatchingScheduler",
+    "Request", "QueueFull", "CompileCache", "bucket_length", "ServeMetrics",
+    "percentile", "SPAN_DECODE", "SPAN_PREFILL", "COUNTER_QUEUE",
+    "COUNTER_OCCUPANCY",
+]

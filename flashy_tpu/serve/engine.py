@@ -1,0 +1,330 @@
+# Slot-based decode engine. One KV cache of static shape
+# [S, max_seq_len] is partitioned into S per-request slots; ONE compiled
+# decode step of shape [S, 1] advances every live slot together, however
+# many are live (an active mask, not a shape change, expresses liveness
+# — so the executable never recompiles as requests come and go, the
+# compiler-first caching discipline of the SSD/O(1)-cache line of work).
+# Prefill writes a new request's prompt K/V into its slot through
+# per-power-of-two-bucket executables, so the whole serving lifetime
+# touches a fixed, pre-warmable set of compiled shapes.
+"""DecodeEngine: fixed-slot KV cache + one static-shape decode step."""
+import logging
+import typing as tp
+
+import numpy as np
+
+from ..observability import Tracer
+from .compile_cache import CompileCache, bucket_length
+
+logger = logging.getLogger(__name__)
+
+# Tracer span/counter kinds for the serving path (category "serve").
+SPAN_PREFILL = "serve/prefill"
+SPAN_DECODE = "serve/decode"
+
+
+class SlotAllocator:
+    """Free-list over the S cache slots.
+
+    `acquire()` hands out the lowest free slot (deterministic, so tests
+    and traces are reproducible) or None when every slot is live;
+    `release()` returns a slot to the pool. Double-release and
+    out-of-range slots raise — both are scheduler bugs worth failing
+    loudly on, not states to paper over.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"need at least one slot, got {capacity}")
+        self.capacity = capacity
+        self._free = list(range(capacity - 1, -1, -1))  # pop() -> lowest
+        self._live: tp.Set[int] = set()
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_count(self) -> int:
+        return len(self._live)
+
+    @property
+    def live(self) -> tp.FrozenSet[int]:
+        return frozenset(self._live)
+
+    def acquire(self) -> tp.Optional[int]:
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._live.add(slot)
+        return slot
+
+    def release(self, slot: int) -> None:
+        if slot not in self._live:
+            raise ValueError(f"slot {slot} is not live (free: double "
+                             f"release?) — live set: {sorted(self._live)}")
+        self._live.discard(slot)
+        self._free.append(slot)
+        self._free.sort(reverse=True)  # keep lowest-first hand-out
+
+
+class DecodeEngine:
+    """S-slot KV cache + compiled prefill/decode steps over it.
+
+    Purely tensor-level: it owns the cache, the per-slot device-visible
+    state (last token, length, active mask) and the CompileCache of
+    executables; request semantics (queueing, retirement, metrics) live
+    in the scheduler. Greedy by default; `temperature > 0` samples with
+    a per-step split of `rng`.
+
+    Args:
+        model: a TransformerLM (its config drives shapes/dtype).
+        params: the model variables ({'params': ...}).
+        slots: S, the number of concurrent requests.
+        max_seq_len: per-slot cache length; defaults to (and is capped
+            by) the model's `config.max_seq_len`.
+        temperature: 0 -> greedy (bit-identical to `generate()`);
+            > 0 -> categorical sampling.
+        rng: PRNG key for sampling (required when temperature > 0).
+        pad_token: token id emitted for inactive slots and used to pad
+            prompts up to their bucket (never attended: causal mask).
+        compile_cache: a CompileCache to share; by default one is built
+            against the active telemetry's watchdog/tracer
+            (`observability.get_telemetry()`), falling back to a
+            private watchdog so recompile accounting always works.
+    """
+
+    def __init__(self, model, params, *, slots: int,
+                 max_seq_len: tp.Optional[int] = None,
+                 temperature: float = 0.0,
+                 rng: tp.Optional[tp.Any] = None,
+                 pad_token: int = 0,
+                 min_bucket: int = 4,
+                 compile_cache: tp.Optional[CompileCache] = None,
+                 tracer: tp.Optional[Tracer] = None):
+        import jax
+        import jax.numpy as jnp
+        from ..models.decoding import init_cache
+
+        self._model = model
+        self._params = params
+        self._cfg = model.config
+        self.slots = slots
+        self.max_seq_len = min(max_seq_len or self._cfg.max_seq_len,
+                               self._cfg.max_seq_len)
+        self.temperature = float(temperature)
+        if self.temperature > 0.0 and rng is None:
+            raise ValueError("DecodeEngine(temperature>0) samples and needs "
+                             "an explicit `rng` key (greedy needs none).")
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.pad_token = int(pad_token)
+        self.min_bucket = int(min_bucket)
+        self.allocator = SlotAllocator(slots)
+
+        if tracer is None or compile_cache is None:
+            from ..observability import get_telemetry
+            telemetry = get_telemetry()
+            if tracer is None and telemetry is not None:
+                tracer = telemetry.tracer
+            if compile_cache is None:
+                compile_cache = CompileCache(
+                    watchdog=telemetry.watchdog if telemetry else None,
+                    tracer=tracer)
+        self.tracer = tracer
+        self.compile_cache = compile_cache
+
+        # Device-side per-slot state. Inactive slots park at position
+        # `max_seq_len`: their decode writes fall out of range and are
+        # dropped (mode="drop" in the cache scatter), so a freed slot
+        # can never corrupt a neighbour.
+        self._cache = init_cache(self._cfg, slots, self.max_seq_len)
+        self._tokens = jnp.full((slots,), self.pad_token, jnp.int32)
+        self._positions = jnp.full((slots,), self.max_seq_len, jnp.int32)
+        self._active = jnp.zeros((slots,), bool)
+        # donation lets XLA update the cache in place on accelerators;
+        # the CPU backend would only warn, so skip it there.
+        self._donate = () if jax.default_backend() == "cpu" else (1,)
+
+    # ------------------------------------------------------------------
+    # compiled steps
+    # ------------------------------------------------------------------
+    def _sample(self, logits, key):
+        """Next token from [S, V] logits (matches generate()'s rule)."""
+        import jax
+        import jax.numpy as jnp
+        if self.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / self.temperature, axis=-1).astype(jnp.int32)
+
+    def _build_decode(self) -> tp.Callable:
+        import jax
+        import jax.numpy as jnp
+        from ..models.decoding import _apply_step
+        model, cfg, pad = self._model, self._cfg, self.pad_token
+
+        def decode(params, cache, tokens, positions, active, key):
+            # tokens/positions/active: [S]; ONE executable for any mix
+            # of live slots — liveness is data, not shape.
+            logits, cache = _apply_step(
+                model, params, cfg, tokens[:, None], positions[:, None],
+                cache, positions)
+            nxt = self._sample(logits[:, -1], key)
+            return jnp.where(active, nxt, jnp.int32(pad)), cache
+
+        return jax.jit(decode, donate_argnums=self._donate)
+
+    def _build_prefill(self, bucket: int) -> tp.Callable:
+        import jax
+        import jax.numpy as jnp
+        from ..models.decoding import _apply_step, init_cache
+        model, cfg = self._model, self._cfg
+
+        def prefill(params, cache, prompt, length, slot, key):
+            # prompt: [1, bucket] right-padded; length/slot: scalars.
+            # Pad positions >= length are never attended (causal mask)
+            # and their K/V rows are overwritten by decode writes before
+            # any query can reach them, so right-padding is exact.
+            mini = init_cache(cfg, 1, bucket)
+            positions = jnp.arange(bucket, dtype=jnp.int32)[None]
+            logits, mini = _apply_step(model, params, cfg, prompt,
+                                       positions, mini, jnp.int32(0))
+            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                                axis=0, keepdims=True)
+            first = self._sample(last, key)[0]
+
+            def merge(big, small):
+                start = (0,) * (big.ndim - 4) + (slot, 0, 0, 0)
+                return jax.lax.dynamic_update_slice(
+                    big, small.astype(big.dtype), start)
+
+            cache = jax.tree_util.tree_map(merge, cache, mini)
+            return first, cache
+
+        return jax.jit(prefill, donate_argnums=self._donate)
+
+    def _next_key(self):
+        import jax
+        if self.temperature <= 0.0:
+            return self._rng  # greedy: the key is never consulted
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ------------------------------------------------------------------
+    # public surface
+    # ------------------------------------------------------------------
+    def bucket_for(self, prompt_len: int) -> int:
+        """The compiled prefill bucket a prompt of this length lands in."""
+        return bucket_length(prompt_len, minimum=self.min_bucket,
+                             maximum=self.max_seq_len)
+
+    def warmup(self, prompt_lengths: tp.Iterable[int] = ()) -> None:
+        """Pre-compile the decode step + the buckets covering
+        `prompt_lengths` (plus the minimum bucket), so live traffic
+        never waits on XLA. Runs each executable once on scratch inputs;
+        slot state is restored to empty afterwards.
+        """
+        import jax.numpy as jnp
+        buckets = {self.min_bucket}
+        buckets.update(self.bucket_for(n) for n in prompt_lengths)
+        for bucket in sorted(buckets):
+            dummy = jnp.full((1, bucket), self.pad_token, jnp.int32)
+            _, self._cache = self.compile_cache.warm(
+                ("prefill", bucket), lambda: self._build_prefill(bucket),
+                self._params, self._cache, dummy, jnp.int32(1),
+                jnp.int32(0), self._next_key())
+        _, self._cache = self.compile_cache.warm(
+            ("decode", self.slots), self._build_decode,
+            self._params, self._cache, self._tokens, self._positions,
+            self._active, self._next_key())
+        # warm-up wrote scratch K/V at slot 0 position 0; a real prefill
+        # overwrites it before that slot ever decodes, but reset the
+        # host-visible state anyway so the engine starts pristine.
+        self._tokens = jnp.full((self.slots,), self.pad_token, jnp.int32)
+        self._positions = jnp.full((self.slots,), self.max_seq_len, jnp.int32)
+        self._active = jnp.zeros((self.slots,), bool)
+        logger.info("serve warm-up done: %d executables (%s)",
+                    len(self.compile_cache),
+                    ", ".join(f"prefill/{b}" for b in sorted(buckets))
+                    + f", decode/{self.slots}")
+
+    def acquire_slot(self) -> tp.Optional[int]:
+        """Claim a free slot (None when all are live); prefill into it."""
+        return self.allocator.acquire()
+
+    def prefill(self, slot: int, prompt: np.ndarray) -> int:
+        """Run `prompt` (1-D int tokens) into `slot`; returns the first
+        generated token. The slot must have been `acquire()`d."""
+        import jax.numpy as jnp
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(f"prompt must be 1-D and non-empty, "
+                             f"got shape {prompt.shape}")
+        if slot not in self.allocator.live:
+            raise ValueError(f"slot {slot} was not acquired")
+        length = int(prompt.size)
+        bucket = self.bucket_for(length)
+        padded = np.full((1, bucket), self.pad_token, np.int32)
+        padded[0, :length] = prompt
+        fn = self.compile_cache.get(
+            ("prefill", bucket), lambda: self._build_prefill(bucket))
+        span = (self.tracer.span(SPAN_PREFILL, category="serve", slot=slot,
+                                 bucket=bucket, length=length)
+                if self.tracer else _null_span())
+        with span:
+            first, self._cache = fn(self._params, self._cache,
+                                    jnp.asarray(padded), jnp.int32(length),
+                                    jnp.int32(slot), self._next_key())
+            first = int(first)
+        self._tokens = self._tokens.at[slot].set(first)
+        self._positions = self._positions.at[slot].set(length)
+        self._active = self._active.at[slot].set(True)
+        return first
+
+    def decode(self) -> np.ndarray:
+        """One [S, 1] decode step over every slot; returns the [S] next
+        tokens (pad_token on inactive slots). Always the same compiled
+        executable, whatever the live mix."""
+        fn = self.compile_cache.get(("decode", self.slots),
+                                    self._build_decode)
+        span = (self.tracer.span(SPAN_DECODE, category="serve",
+                                 live=self.allocator.live_count)
+                if self.tracer else _null_span())
+        with span:
+            tokens, self._cache = fn(self._params, self._cache, self._tokens,
+                                     self._positions, self._active,
+                                     self._next_key())
+            out = np.asarray(tokens)
+        # feed each live slot its own token back; lengths advance by 1
+        self._tokens = tokens
+        self._positions = self._positions + self._active.astype(
+            self._positions.dtype)
+        return out
+
+    def retire(self, slot: int) -> None:
+        """Free `slot`: deactivate it and park its position out of range
+        so pending decode writes drop instead of landing in the cache."""
+        self._active = self._active.at[slot].set(False)
+        self._positions = self._positions.at[slot].set(self.max_seq_len)
+        self._tokens = self._tokens.at[slot].set(self.pad_token)
+        self.allocator.release(slot)
+
+    def slot_length(self, slot: int) -> int:
+        """Current sequence length of a live slot (prompt + generated)."""
+        return int(self._positions[slot])
+
+    @property
+    def live_count(self) -> int:
+        return self.allocator.live_count
+
+    @property
+    def free_count(self) -> int:
+        return self.allocator.free_count
+
+
+class _null_span:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,127 @@
+# Serving metrics. The numbers an operator actually pages on: how long
+# until a request's first token (TTFT — queue wait + prefill), how fast
+# tokens stream after that (inter-token latency), how deep the admission
+# queue is running and how full the slot pool is. Collected as raw
+# samples host-side (cheap appends), summarized as p50/p95 on demand,
+# fanned out through the PR 1 Tracer (live Perfetto counter tracks +
+# telemetry.jsonl records) and through ResultLogger to every experiment
+# logging backend, and snapshotted to `serve.json` in the XP folder for
+# `python -m flashy_tpu.info`.
+"""ServeMetrics: TTFT / inter-token latency / queue depth / occupancy."""
+import json
+import typing as tp
+from pathlib import Path
+
+from ..observability import Tracer
+from ..utils import percentile, write_and_rename
+from ..xp import SERVE_STATUS_NAME, AnyPath
+
+# Perfetto counter-track kinds for the serving path.
+COUNTER_QUEUE = "serve/queue_depth"
+COUNTER_OCCUPANCY = "serve/slot_occupancy"
+
+
+class ServeMetrics:
+    """Accumulates serving samples; summarizes and fans them out.
+
+    All hooks are cheap (list appends + an optional tracer counter), so
+    the scheduler calls them unconditionally. Times are seconds
+    (`time.perf_counter` deltas); the summary reports milliseconds —
+    serving latencies read naturally in ms, and the formatter
+    (`flashy_tpu.logging.serve_formatter`) keys off the `_ms` suffix.
+    """
+
+    def __init__(self, tracer: tp.Optional[Tracer] = None):
+        self.tracer = tracer
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.tokens = 0
+        self.finish_reasons: tp.Dict[str, int] = {}
+        self.ttft: tp.List[float] = []
+        self.itl: tp.List[float] = []
+        self.latency: tp.List[float] = []
+        self.queue_depth: tp.List[int] = []
+        self.occupancy: tp.List[float] = []
+
+    # ------------------------------------------------------------------
+    # scheduler hooks
+    # ------------------------------------------------------------------
+    def on_submit(self) -> None:
+        self.submitted += 1
+
+    def on_reject(self) -> None:
+        self.rejected += 1
+
+    def on_first_token(self, ttft_seconds: float) -> None:
+        self.ttft.append(ttft_seconds)
+        self.tokens += 1
+
+    def on_token(self, gap_seconds: float) -> None:
+        self.itl.append(gap_seconds)
+        self.tokens += 1
+
+    def on_done(self, latency_seconds: float, reason: str) -> None:
+        self.completed += 1
+        self.latency.append(latency_seconds)
+        self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
+
+    def on_gauges(self, queue_depth: int, live: int, capacity: int) -> None:
+        """Sample the queue depth + slot occupancy (once per step)."""
+        occupancy = live / capacity if capacity else 0.0
+        self.queue_depth.append(queue_depth)
+        self.occupancy.append(occupancy)
+        if self.tracer is not None:
+            self.tracer.counter(COUNTER_QUEUE, depth=queue_depth)
+            self.tracer.counter(COUNTER_OCCUPANCY, live=live,
+                                occupancy=occupancy)
+
+    # ------------------------------------------------------------------
+    # fan-out
+    # ------------------------------------------------------------------
+    def summary(self) -> tp.Dict[str, float]:
+        """Flat numeric snapshot (ms latencies, p50/p95 distributions)."""
+        out: tp.Dict[str, float] = {
+            "requests": self.submitted,
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "tokens": self.tokens,
+        }
+        for name, samples, scale in (("ttft_ms", self.ttft, 1e3),
+                                     ("itl_ms", self.itl, 1e3),
+                                     ("latency_ms", self.latency, 1e3),
+                                     ("queue_depth", self.queue_depth, 1),
+                                     ("occupancy", self.occupancy, 1)):
+            out[f"{name}_p50"] = percentile(samples, 50) * scale
+            out[f"{name}_p95"] = percentile(samples, 95) * scale
+        for reason, count in sorted(self.finish_reasons.items()):
+            out[f"finish_{reason}"] = count
+        return out
+
+    def log_to(self, result_logger: tp.Any, step: tp.Optional[int] = None,
+               extra: tp.Optional[tp.Dict[str, float]] = None) -> None:
+        """Fan the summary out through a ResultLogger ('serve' stage)."""
+        from ..logging import serve_formatter
+        metrics = self.summary()
+        if extra:
+            metrics.update(extra)
+        result_logger.log_metrics("serve", metrics, step=step,
+                                  formatter=serve_formatter())
+
+    def record(self) -> None:
+        """Append the summary to telemetry.jsonl via the tracer."""
+        if self.tracer is not None:
+            self.tracer.record({"type": "serve_summary", **self.summary()})
+
+    def write_status(self, folder: AnyPath,
+                     extra: tp.Optional[tp.Dict[str, tp.Any]] = None) -> Path:
+        """Snapshot the summary to `<folder>/serve.json` (atomic) for
+        `python -m flashy_tpu.info`; returns the path."""
+        target = Path(folder) / SERVE_STATUS_NAME
+        payload = self.summary()
+        if extra:
+            payload.update(extra)
+        target.parent.mkdir(parents=True, exist_ok=True)
+        with write_and_rename(target, "w") as f:
+            json.dump(payload, f, indent=2, default=float)
+        return target

@@ -1,0 +1,213 @@
+# Continuous batching. The classic serving mistake is batch-synchronous
+# decode: admit a batch, run it to completion, admit the next — short
+# requests wait on the longest one and freed capacity idles. Continuous
+# batching retires each request the moment it finishes (EOS or length
+# budget) and prefills the next queued request into the freed slot while
+# decode keeps streaming for everyone else. The queue is FIFO (arrival
+# order == admission order — the fairness the tests assert) with a hard
+# depth cap: `submit()` past it raises QueueFull, the backpressure
+# signal a front-end turns into HTTP 429 / retry-after.
+"""ContinuousBatchingScheduler: FIFO admission into engine slots."""
+import collections
+import dataclasses
+import itertools
+import logging
+import time
+import typing as tp
+
+import numpy as np
+
+from .engine import DecodeEngine
+from .metrics import ServeMetrics
+
+logger = logging.getLogger(__name__)
+
+
+class QueueFull(RuntimeError):
+    """Raised by `submit()` when the admission queue is at capacity.
+
+    This IS the backpressure mechanism: the caller sheds or retries;
+    the scheduler never buffers unboundedly.
+    """
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its lifecycle record.
+
+    States: queued -> running -> done. `generated` grows one token per
+    engine step; `output` is prompt + generated (the EOS, when one
+    fired, is included — it is the terminator the model actually
+    emitted, matching `generate(eos_token=...)`).
+    """
+    uid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token: tp.Optional[int] = None
+    state: str = "queued"
+    slot: tp.Optional[int] = None
+    generated: tp.List[int] = dataclasses.field(default_factory=list)
+    submitted_at: float = 0.0
+    first_token_at: tp.Optional[float] = None
+    finished_at: tp.Optional[float] = None
+    finish_reason: tp.Optional[str] = None  # 'eos' | 'length'
+
+    @property
+    def done(self) -> bool:
+        return self.state == "done"
+
+    @property
+    def output(self) -> np.ndarray:
+        """prompt + generated tokens, as one int32 array."""
+        return np.concatenate([np.asarray(self.prompt, np.int32),
+                               np.asarray(self.generated, np.int32)])
+
+
+class ContinuousBatchingScheduler:
+    """FIFO request queue feeding a DecodeEngine's slots.
+
+    One `step()` = admit (prefill queued requests into free slots) +
+    one engine decode over all S slots + retire finished requests.
+    Decode never waits for admission and admission never waits for a
+    batch boundary — capacity freed mid-stream is refilled on the next
+    step while the other slots keep generating.
+
+    Args:
+        engine: the DecodeEngine supplying slots and compiled steps.
+        max_queue: admission-queue depth; `submit()` past it raises
+            QueueFull (backpressure).
+        metrics: a ServeMetrics; one is created (sharing the engine's
+            tracer) when not given.
+    """
+
+    def __init__(self, engine: DecodeEngine, max_queue: int = 128,
+                 metrics: tp.Optional[ServeMetrics] = None):
+        self.engine = engine
+        self.max_queue = max_queue
+        self.metrics = metrics or ServeMetrics(tracer=engine.tracer)
+        self._queue: tp.Deque[Request] = collections.deque()
+        self._running: tp.Dict[int, Request] = {}  # slot -> request
+        self._uid = itertools.count()
+        self.admitted_order: tp.List[int] = []  # uids, admission sequence
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def live_count(self) -> int:
+        return len(self._running)
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and not self._running
+
+    def submit(self, prompt: tp.Any, max_new_tokens: int,
+               eos_token: tp.Optional[int] = None) -> Request:
+        """Queue one request; returns its Request handle.
+
+        Raises QueueFull at the depth cap and ValueError for requests
+        that could never fit the cache (so an impossible request fails
+        at the door, not after queueing behind everyone else).
+        """
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(f"prompt must be 1-D non-empty, got {prompt.shape}")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        total = prompt.size + max_new_tokens
+        if total > self.engine.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the engine's "
+                f"max_seq_len {self.engine.max_seq_len}")
+        if len(self._queue) >= self.max_queue:
+            self.metrics.on_reject()
+            raise QueueFull(
+                f"admission queue is at capacity ({self.max_queue}); "
+                f"retry after in-flight requests drain")
+        request = Request(uid=next(self._uid), prompt=prompt,
+                          max_new_tokens=max_new_tokens, eos_token=eos_token,
+                          submitted_at=time.perf_counter())
+        self._queue.append(request)
+        self.metrics.on_submit()
+        return request
+
+    def _admit(self) -> int:
+        """Prefill queued requests into free slots; returns #admitted."""
+        admitted = 0
+        while self._queue and self.engine.free_count:
+            request = self._queue.popleft()
+            slot = self.engine.acquire_slot()
+            assert slot is not None
+            first = self.engine.prefill(slot, request.prompt)
+            now = time.perf_counter()
+            request.state = "running"
+            request.slot = slot
+            request.first_token_at = now
+            request.generated.append(first)
+            self.admitted_order.append(request.uid)
+            self.metrics.on_first_token(now - request.submitted_at)
+            admitted += 1
+            if (request.eos_token is not None and first == request.eos_token):
+                self._finish(request, "eos")
+            elif len(request.generated) >= request.max_new_tokens:
+                self._finish(request, "length")
+            else:
+                self._running[slot] = request
+        return admitted
+
+    # ------------------------------------------------------------------
+    # decode + retirement
+    # ------------------------------------------------------------------
+    def _finish(self, request: Request, reason: str) -> None:
+        request.state = "done"
+        request.finish_reason = reason
+        request.finished_at = time.perf_counter()
+        self.engine.retire(request.slot)
+        self.metrics.on_done(request.finished_at - request.submitted_at,
+                             reason)
+        logger.debug("request %d done (%s): %d prompt + %d generated",
+                     request.uid, reason, request.prompt.size,
+                     len(request.generated))
+
+    def step(self) -> int:
+        """Admit + one decode step + retire; returns #tokens emitted."""
+        self._admit()
+        self.metrics.on_gauges(queue_depth=len(self._queue),
+                               live=self.engine.live_count,
+                               capacity=self.engine.slots)
+        if not self._running:
+            return 0
+        step_start = time.perf_counter()
+        tokens = self.engine.decode()
+        gap = time.perf_counter() - step_start
+        emitted = 0
+        for slot, request in list(self._running.items()):
+            token = int(tokens[slot])
+            request.generated.append(token)
+            emitted += 1
+            self.metrics.on_token(gap)
+            if request.eos_token is not None and token == request.eos_token:
+                del self._running[slot]
+                self._finish(request, "eos")
+            elif len(request.generated) >= request.max_new_tokens:
+                del self._running[slot]
+                self._finish(request, "length")
+        return emitted
+
+    def run(self, max_steps: int = 1_000_000) -> None:
+        """Step until every queued/running request finished.
+
+        `max_steps` is a watchdog against scheduler bugs (a request that
+        can never retire); hitting it raises instead of spinning.
+        """
+        for _ in range(max_steps):
+            if self.idle:
+                return
+            self.step()
+        raise RuntimeError(
+            f"scheduler did not drain in {max_steps} steps: "
+            f"{len(self._queue)} queued, {len(self._running)} running")

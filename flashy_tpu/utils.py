@@ -28,6 +28,26 @@ def _scalar(value: tp.Any) -> float:
     return float(value)
 
 
+def percentile(samples: tp.Sequence[float], q: float) -> float:
+    """Linear-interpolated percentile (numpy semantics, stdlib-only).
+
+    The one percentile used everywhere numbers are summarized (StepTimer
+    step splits, the serving TTFT/ITL/occupancy surface) so a p95 means
+    the same thing across subsystems. q is in [0, 100]; empty input
+    returns 0.0 so summaries of an idle run stay well-formed.
+    """
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    if len(ordered) == 1:
+        return float(ordered[0])
+    rank = (q / 100.0) * (len(ordered) - 1)
+    low = int(rank)
+    high = min(low + 1, len(ordered) - 1)
+    frac = rank - low
+    return float(ordered[low] * (1.0 - frac) + ordered[high] * frac)
+
+
 def averager(beta: float = 1.0) -> tp.Callable[..., tp.Dict[str, float]]:
     """Exponential Moving Average callback over dicts of metrics.
 

@@ -48,6 +48,9 @@ RUN_INFO_NAME = "run.json"
 TELEMETRY_NAME = "telemetry.jsonl"
 TRACE_NAME = "trace.json"
 HEARTBEAT_DIR_NAME = "heartbeats"
+# Serving status snapshot (written by flashy_tpu.serve's metrics
+# surface; flashy_tpu.info shows it next to the training history).
+SERVE_STATUS_NAME = "serve.json"
 
 
 class Config(dict):

@@ -185,6 +185,50 @@ def test_generate_jitted_with_sharded_params():
     assert ((arr >= 0) & (arr < 64)).all()
 
 
+def test_generate_requires_rng_when_sampling():
+    # the docstring always said rng is required for temperature > 0; the
+    # code used to silently substitute PRNGKey(0), making "sampled"
+    # outputs identical across calls — now it raises up front.
+    model, params = _model_and_params()
+    prompt = jnp.ones((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="rng"):
+        generate(model, params, prompt, max_new_tokens=3, temperature=0.8)
+    # greedy needs no key
+    out = generate(model, params, prompt, max_new_tokens=2)
+    assert out.shape == (1, 6)
+
+
+def test_generate_eos_token_pins_tail():
+    # once a row emits eos_token, every later token of that row is
+    # pinned to it (mask-based, inside the scan — shapes stay static).
+    model, params = _model_and_params()
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, (2, 5)), jnp.int32)
+    free = np.asarray(generate(model, params, prompt, max_new_tokens=8))
+    # use a token the free run actually emits mid-stream as the EOS id
+    eos = int(free[0, 5 + 2])
+    out = np.asarray(generate(model, params, prompt, max_new_tokens=8,
+                              eos_token=eos))
+    assert out.shape == free.shape  # static shapes: still 8 new tokens
+    for row in range(2):
+        gen, ref = out[row, 5:], free[row, 5:]
+        hits = np.nonzero(ref == eos)[0]
+        if hits.size:  # prefix up to the first EOS agrees; tail pinned
+            first = hits[0]
+            np.testing.assert_array_equal(gen[:first + 1], ref[:first + 1])
+            assert (gen[first:] == eos).all()
+        else:  # a row that never emits EOS is untouched
+            np.testing.assert_array_equal(gen, ref)
+
+
+def test_generate_eos_token_jittable():
+    model, params = _model_and_params()
+    prompt = jnp.ones((1, 4), jnp.int32)
+    fn = jax.jit(lambda p, t: generate(model, p, t, max_new_tokens=3,
+                                       eos_token=7))
+    assert fn(params, prompt).shape == (1, 7)
+
+
 def test_nucleus_filter_keeps_smallest_top_mass_prefix():
     from flashy_tpu.models.decoding import nucleus_filter
 

@@ -55,3 +55,13 @@ def test_int_and_str_values():
     formatter = Formatter({"epoch": "d", "name": "s"})
     out = formatter({"epoch": 7, "name": "run"})
     assert out == {"epoch": "7", "name": "run"}
+
+
+def test_callable_format_spec():
+    # a callable spec renders things format() cannot (unit suffixes);
+    # the serving formatter (flashy_tpu.logging.serve_formatter) relies
+    # on this for ms/percent displays.
+    formatter = Formatter({"lat*": lambda v: f"{v:.0f}ms",
+                           "occ": lambda v: f"{v * 100:.0f}%"})
+    out = formatter({"lat_p50": 12.6, "occ": 0.875, "loss": 0.5})
+    assert out == {"lat_p50": "13ms", "occ": "88%", "loss": "0.500"}

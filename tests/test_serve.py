@@ -1,0 +1,348 @@
+# The serving subsystem: slot allocation, shape bucketing, compile-cache
+# accounting (asserted through the recompile watchdog), scheduler
+# fairness/backpressure, and the end-to-end contract — N requests
+# through the continuous-batching engine produce exactly the tokens
+# per-request generate() produces, with zero post-warm-up compiles.
+import logging
+
+import numpy as np
+import pytest
+
+from flashy_tpu.serve import (
+    CompileCache, ContinuousBatchingScheduler, DecodeEngine, QueueFull,
+    ServeMetrics, SlotAllocator, bucket_length, percentile,
+)
+
+
+# ----------------------------------------------------------------------
+# slot allocator
+# ----------------------------------------------------------------------
+def test_slot_allocator_reuse_and_exhaustion():
+    alloc = SlotAllocator(3)
+    assert alloc.free_count == 3 and alloc.live_count == 0
+    slots = [alloc.acquire() for _ in range(3)]
+    assert slots == [0, 1, 2]  # lowest-first, deterministic
+    assert alloc.acquire() is None  # exhausted -> None, not an error
+    alloc.release(1)
+    assert alloc.free_count == 1
+    assert alloc.acquire() == 1  # freed slot is reused
+    assert alloc.live == frozenset({0, 1, 2})
+
+
+def test_slot_allocator_rejects_bad_release():
+    alloc = SlotAllocator(2)
+    with pytest.raises(ValueError, match="not live"):
+        alloc.release(0)  # never acquired
+    slot = alloc.acquire()
+    alloc.release(slot)
+    with pytest.raises(ValueError, match="not live"):
+        alloc.release(slot)  # double release
+    with pytest.raises(ValueError):
+        SlotAllocator(0)
+
+
+# ----------------------------------------------------------------------
+# bucketing
+# ----------------------------------------------------------------------
+def test_bucket_length_power_of_two():
+    assert bucket_length(1) == 4  # minimum
+    assert bucket_length(4) == 4
+    assert bucket_length(5) == 8
+    assert bucket_length(9) == 16
+    assert bucket_length(16) == 16
+    assert bucket_length(17, maximum=64) == 32
+    # cap: bucket never exceeds maximum, lengths beyond it raise
+    assert bucket_length(40, maximum=48) == 48
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_length(49, maximum=48)
+    with pytest.raises(ValueError):
+        bucket_length(0)
+
+
+# ----------------------------------------------------------------------
+# compile cache
+# ----------------------------------------------------------------------
+def test_compile_cache_hit_miss_accounting():
+    import jax
+    import jax.numpy as jnp
+
+    cache = CompileCache()
+    build = lambda: jax.jit(lambda x: x + 1)  # noqa: E731
+    fn = cache.get(("step", 4), build)
+    assert cache.stats() == {"hits": 0, "misses": 1, "entries": 1,
+                             "recompiles": 0}
+    assert cache.get(("step", 4), build) is fn  # hit returns same object
+    assert cache.get(("step", 8), build) is not fn
+    assert cache.stats()["hits"] == 1 and cache.stats()["misses"] == 2
+
+    # first call compiles (within warm-up), same shape again doesn't
+    fn(jnp.zeros((4,)))
+    fn(jnp.zeros((4,)))
+    assert cache.recompiles() == 0
+    # a shape change past warm-up IS a recompile — the watchdog sees it
+    fn(jnp.zeros((5,)))
+    assert cache.recompiles() == 1
+    assert cache.watchdog.counts["step/4"]["recompiles"] == 1
+
+
+def test_compile_cache_rejects_unjitted_build():
+    cache = CompileCache()
+    with pytest.raises(TypeError, match="jit"):
+        cache.get(("plain",), lambda: (lambda x: x))
+
+
+def test_compile_cache_warm_executes_once():
+    import jax
+    import jax.numpy as jnp
+
+    cache = CompileCache()
+    out = cache.warm(("inc",), lambda: jax.jit(lambda x: x * 2),
+                     jnp.asarray(3))
+    assert int(out) == 6
+    assert cache.stats()["misses"] == 1
+    # warm consumed the watchdog's warm-up compile budget
+    assert cache.watchdog.counts["inc"]["compiles"] == 1
+
+
+# ----------------------------------------------------------------------
+# metrics
+# ----------------------------------------------------------------------
+def test_percentile_interpolation():
+    assert percentile([], 50) == 0.0
+    assert percentile([3.0], 95) == 3.0
+    xs = [1.0, 2.0, 3.0, 4.0]
+    assert percentile(xs, 0) == 1.0
+    assert percentile(xs, 100) == 4.0
+    assert percentile(xs, 50) == 2.5
+    assert np.isclose(percentile(xs, 95), np.percentile(xs, 95))
+
+
+def test_serve_metrics_summary_and_status(tmp_path):
+    metrics = ServeMetrics()
+    for _ in range(3):
+        metrics.on_submit()
+    metrics.on_reject()
+    metrics.on_first_token(0.010)
+    metrics.on_token(0.002)
+    metrics.on_token(0.004)
+    metrics.on_done(0.050, "eos")
+    metrics.on_gauges(queue_depth=2, live=1, capacity=4)
+    summary = metrics.summary()
+    assert summary["requests"] == 3
+    assert summary["rejected"] == 1
+    assert summary["completed"] == 1
+    assert summary["tokens"] == 3
+    assert np.isclose(summary["ttft_ms_p50"], 10.0)
+    assert np.isclose(summary["itl_ms_p50"], 3.0)
+    assert summary["occupancy_p50"] == 0.25
+    assert summary["finish_eos"] == 1
+
+    path = metrics.write_status(tmp_path)
+    import json
+    assert json.loads(path.read_text())["requests"] == 3
+
+
+def test_serve_formatter_renders_units():
+    from flashy_tpu.logging import serve_formatter
+
+    out = serve_formatter()({"ttft_ms_p50": 12.34, "occupancy_p95": 0.875,
+                             "requests": 32, "queue_depth_p50": 1.5})
+    assert out["ttft_ms_p50"] == "12.3ms"
+    assert out["occupancy_p95"] == "88%"
+    assert out["requests"] == "32"
+    assert out["queue_depth_p50"] == "1.5"
+
+
+def test_format_serve_status_line():
+    from flashy_tpu.info import format_serve_status
+
+    line = format_serve_status({"requests": 32, "completed": 32,
+                                "ttft_ms_p50": 99.35, "occupancy_p50": 1.0,
+                                "unknown_future_key": 7})
+    assert "requests=32" in line
+    assert "ttft_ms_p50=99.3" in line
+    assert "occupancy_p50=100%" in line
+
+
+# ----------------------------------------------------------------------
+# engine + scheduler (tiny model)
+# ----------------------------------------------------------------------
+def _tiny_model(vocab=32, max_seq_len=32):
+    import jax
+    import jax.numpy as jnp
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=vocab, dim=16, num_layers=2,
+                            num_heads=2, attention="dense",
+                            max_seq_len=max_seq_len, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32))
+    return model, params
+
+
+def test_scheduler_backpressure_and_admission_validation():
+    model, params = _tiny_model()
+    engine = DecodeEngine(model, params, slots=2)
+    scheduler = ContinuousBatchingScheduler(engine, max_queue=2)
+    prompt = np.arange(4, dtype=np.int32) % 32
+
+    with pytest.raises(ValueError, match="max_seq_len"):
+        scheduler.submit(prompt, max_new_tokens=40)  # can never fit
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        scheduler.submit(prompt, max_new_tokens=0)
+    scheduler.submit(prompt, max_new_tokens=2)
+    scheduler.submit(prompt, max_new_tokens=2)
+    with pytest.raises(QueueFull):
+        scheduler.submit(prompt, max_new_tokens=2)
+    assert scheduler.metrics.rejected == 1
+    assert scheduler.queue_depth == 2
+
+
+def test_engine_requires_rng_for_sampling():
+    model, params = _tiny_model()
+    with pytest.raises(ValueError, match="rng"):
+        DecodeEngine(model, params, slots=2, temperature=0.7)
+
+
+@pytest.mark.slow
+def test_serve_matches_generate_end_to_end():
+    # N requests in -> N greedy completions out, token-exact against
+    # per-request generate(); the compile cache shows zero post-warm-up
+    # builds and zero recompiles of the decode step.
+    from flashy_tpu.models.decoding import generate
+
+    model, params = _tiny_model()
+    engine = DecodeEngine(model, params, slots=4)
+    rng = np.random.default_rng(0)
+    workload = [(rng.integers(0, 32, int(n)).astype(np.int32), int(m))
+                for n, m in zip([3, 5, 8, 9, 12, 4, 7, 15, 2, 11, 6, 10],
+                                [4, 6, 3, 8, 5, 7, 4, 6, 9, 3, 5, 4])]
+    engine.warmup(prompt_lengths=[len(p) for p, _ in workload])
+    warm_misses = engine.compile_cache.stats()["misses"]
+
+    scheduler = ContinuousBatchingScheduler(engine, max_queue=32)
+    handles = [scheduler.submit(p, m) for p, m in workload]
+    scheduler.run()
+
+    stats = engine.compile_cache.stats()
+    assert stats["misses"] == warm_misses  # steady state is compile-free
+    assert stats["recompiles"] == 0
+    assert stats["hits"] > 0
+    for handle, (prompt, max_new) in zip(handles, workload):
+        assert handle.done and handle.finish_reason == "length"
+        want = np.asarray(generate(model, params, prompt[None],
+                                   max_new_tokens=max_new))[0]
+        np.testing.assert_array_equal(handle.output, want)
+    # every request freed its slot
+    assert engine.live_count == 0 and engine.free_count == 4
+
+
+@pytest.mark.slow
+def test_serve_eos_retirement_matches_generate():
+    # EOS-aware retirement: pick the token generate() emits mid-stream
+    # as the EOS id; the served request must stop exactly there, and the
+    # prefix must agree with generate(eos_token=...)'s pinned output.
+    from flashy_tpu.models.decoding import generate
+
+    model, params = _tiny_model()
+    prompt = np.asarray([5, 9, 2, 14, 7], np.int32)
+    free_run = np.asarray(generate(model, params, prompt[None],
+                                   max_new_tokens=8))[0]
+    eos = int(free_run[len(prompt) + 2])  # the 3rd generated token
+
+    engine = DecodeEngine(model, params, slots=2)
+    engine.warmup(prompt_lengths=[len(prompt)])
+    scheduler = ContinuousBatchingScheduler(engine)
+    handle = scheduler.submit(prompt, max_new_tokens=8, eos_token=eos)
+    scheduler.run()
+
+    assert handle.done and handle.finish_reason == "eos"
+    assert handle.generated[-1] == eos
+    assert eos not in handle.generated[:-1]
+    pinned = np.asarray(generate(model, params, prompt[None],
+                                 max_new_tokens=8, eos_token=eos))[0]
+    # generate() pins everything after EOS to EOS; the served request
+    # retired at EOS — its output is exactly the un-pinned prefix.
+    np.testing.assert_array_equal(handle.output,
+                                  pinned[:len(prompt) + len(handle.generated)])
+    assert (pinned[len(prompt) + len(handle.generated):] == eos).all()
+    assert engine.free_count == 2  # slot came back
+
+
+@pytest.mark.slow
+def test_scheduler_fifo_fairness_under_poisson_arrivals():
+    # Synthetic Poisson arrival stream against a 2-slot engine:
+    # admission must be FIFO (arrival order == admission order), every
+    # request completes, and the queue drains.
+    model, params = _tiny_model()
+    engine = DecodeEngine(model, params, slots=2)
+    engine.warmup(prompt_lengths=[4, 6, 9])
+    scheduler = ContinuousBatchingScheduler(engine, max_queue=64)
+
+    rng = np.random.default_rng(42)
+    handles = []
+    pending = 14
+    while pending or not scheduler.idle:
+        for _ in range(min(int(rng.poisson(0.9)), pending)):
+            length = int(rng.choice([4, 6, 9]))
+            prompt = rng.integers(0, 32, length).astype(np.int32)
+            handles.append(scheduler.submit(prompt, int(rng.choice([3, 5]))))
+            pending -= 1
+        scheduler.step()
+
+    assert len(handles) == 14
+    assert all(h.done for h in handles)
+    submitted_order = [h.uid for h in handles]
+    assert scheduler.admitted_order == submitted_order  # FIFO fairness
+    # occupancy was actually sampled and the engine fully drained
+    assert scheduler.metrics.occupancy and engine.live_count == 0
+
+
+@pytest.mark.slow
+def test_serve_demo_entrypoint_smoke(caplog):
+    # the `python -m flashy_tpu.serve` acceptance gate, at test size
+    from flashy_tpu.serve.__main__ import run_demo
+
+    with caplog.at_level(logging.INFO, logger="flashy_tpu.serve.demo"):
+        assert run_demo(requests=6, slots=3, verify=True, seed=1) == 0
+
+
+@pytest.mark.slow
+def test_serve_reports_through_telemetry(tmp_path):
+    # with telemetry enabled, the engine picks up the global watchdog/
+    # tracer: serve spans + counter tracks land in the trace, the
+    # compile cache counts through the shared watchdog, and the metrics
+    # snapshot becomes visible to `python -m flashy_tpu.info`.
+    import json
+    from flashy_tpu.observability import enable_telemetry, disable_telemetry
+
+    telemetry = enable_telemetry(folder=tmp_path)
+    try:
+        model, params = _tiny_model()
+        engine = DecodeEngine(model, params, slots=2)
+        assert engine.compile_cache.watchdog is telemetry.watchdog
+        engine.warmup(prompt_lengths=[4])
+        scheduler = ContinuousBatchingScheduler(engine)
+        scheduler.submit(np.asarray([1, 2, 3, 4], np.int32),
+                         max_new_tokens=3)
+        scheduler.run()
+        scheduler.metrics.record()
+        scheduler.metrics.write_status(tmp_path)
+
+        events = telemetry.tracer.events
+        names = {e.get("name") for e in events}
+        assert "serve/decode" in names and "serve/prefill" in names
+        assert "serve/queue_depth" in names  # counter track
+        assert telemetry.watchdog.counts["decode/2"]["recompiles"] == 0
+    finally:
+        disable_telemetry()
+
+    journal = [json.loads(line)
+               for line in (tmp_path / "telemetry.jsonl").read_text()
+               .splitlines()]
+    assert any(rec["type"] == "serve_summary" for rec in journal)
+    status = json.loads((tmp_path / "serve.json").read_text())
+    assert status["completed"] == 1
+
+    from flashy_tpu.info import format_serve_status
+    assert "completed=1" in format_serve_status(status)

@@ -109,7 +109,10 @@ def render_module(module) -> str:
     return _page(name, "\n".join(parts))
 
 
-def iter_modules(package_name: str):
+def iter_modules(package_name: str, skipped: tp.Optional[tp.List[str]] = None):
+    """Yield the package + every public submodule; import failures are
+    skipped softly (optional deps) but collected into `skipped` so
+    --check patterns can turn them into hard errors."""
     package = importlib.import_module(package_name)
     yield package
     for info in pkgutil.walk_packages(package.__path__,
@@ -120,6 +123,8 @@ def iter_modules(package_name: str):
             yield importlib.import_module(info.name)
         except Exception as exc:  # soft deps may be absent
             print(f"skip {info.name}: {exc}", file=sys.stderr)
+            if skipped is not None:
+                skipped.append(info.name)
 
 
 def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
@@ -131,13 +136,18 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                         help="fail (exit 1) unless a page was generated for "
                              "this module — guards against a subpackage "
                              "silently dropping out of the docs because its "
-                             "import started failing (repeatable)")
+                             "import started failing (repeatable). A glob "
+                             "pattern ('flashy_tpu.serve*') requires at "
+                             "least one matching page AND that no matching "
+                             "submodule was skipped over an import error — "
+                             "i.e. the whole subpackage stays documented")
     args = parser.parse_args(argv)
 
     out = Path(args.output)
     out.mkdir(parents=True, exist_ok=True)
     entries = []
-    for module in iter_modules(args.package):
+    skipped: tp.List[str] = []
+    for module in iter_modules(args.package, skipped):
         page = render_module(module)
         fname = module.__name__ + ".html"
         (out / fname).write_text(page)
@@ -153,11 +163,23 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     (out / "index.html").write_text(index)
     print("wrote", out / "index.html")
 
+    from fnmatch import fnmatchcase
+
     documented = {name for name, _, _ in entries}
-    missing = [name for name in args.check if name not in documented]
-    if missing:
-        print("ERROR: no documentation generated for: "
-              + ", ".join(missing), file=sys.stderr)
+    problems = []
+    for check in args.check:
+        if any(ch in check for ch in "*?["):
+            if not any(fnmatchcase(name, check) for name in documented):
+                problems.append(f"no documented module matches {check!r}")
+            broken = [name for name in skipped if fnmatchcase(name, check)]
+            if broken:
+                problems.append(f"{check!r} submodules failed to import: "
+                                + ", ".join(broken))
+        elif check not in documented:
+            problems.append(f"no documentation generated for {check!r}")
+    if problems:
+        for problem in problems:
+            print("ERROR:", problem, file=sys.stderr)
         return 1
     return 0
 
