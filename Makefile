@@ -30,9 +30,19 @@ bench:
 serve-demo:
 	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --requests 32 --slots 8
 
+# Fault-tolerance chaos drill on CPU: train with an injected transient
+# IO fault (must be absorbed by retry), a simulated mid-stage SIGTERM
+# (must stop at a boundary with the requeue exit code) and a corrupted
+# active checkpoint slot (must fall back to the sibling A/B slot), then
+# resume and demand history/metrics identical to an uninterrupted run
+# (exit 1 on any violation). Seconds; also run by the tests workflow.
+chaos-demo:
+	JAX_PLATFORMS=cpu python -m flashy_tpu.resilience --epochs 5
+
 docs:
 	python tools/gendocs.py -o docs/api -p flashy_tpu \
-		-c 'flashy_tpu.observability*' -c 'flashy_tpu.serve*'
+		-c 'flashy_tpu.observability*' -c 'flashy_tpu.serve*' \
+		-c 'flashy_tpu.resilience*'
 
 native:
 	python tools/build_native.py
@@ -40,4 +50,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all coverage bench serve-demo docs native dist
+.PHONY: default linter tests tests-all coverage bench serve-demo chaos-demo docs native dist

@@ -37,3 +37,5 @@ from .utils import averager  # noqa
 from .ema import EMA, ema_update  # noqa
 from .xp import get_xp, main  # noqa
 from . import serve  # noqa — continuous-batching inference serving
+from . import resilience  # noqa — fault tolerance (preemption, integrity, retry)
+from .resilience import enable_preemption_guard  # noqa

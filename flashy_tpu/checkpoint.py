@@ -13,13 +13,60 @@
 #    checkpoints can seed JAX runs and vice versa.
 """Checkpoint IO: single-file, sharded (Orbax), and torch interop."""
 from pathlib import Path
+import logging
 import pickle
 import typing as tp
 
 import jax
 import numpy as np
 
+from .resilience import chaos
+from .resilience.integrity import (CheckpointCorrupted, CheckpointError,
+                                   verify_file, verify_slot, write_manifest,
+                                   write_sidecar)
+from .resilience.retry import call_with_retry
 from .utils import AnyPath, to_numpy, write_and_rename
+
+logger = logging.getLogger(__name__)
+
+
+def _write_state_file(path: AnyPath, payload: tp.Any,
+                      sidecar: bool = True) -> None:
+    """Atomic pickle write, retried on transient IO failure.
+
+    The retried unit is idempotent (write-and-rename) and contains no
+    collective — the rule that makes retrying safe on a pod. `sidecar`
+    writes the integrity sidecar for single-file checkpoints (slots use
+    a per-slot manifest instead, written by `_commit_slot`).
+    """
+
+    def write() -> None:
+        chaos.fault_point("ckpt.write", path=str(path))
+        with write_and_rename(path, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        if sidecar:
+            write_sidecar(path)
+
+    call_with_retry(write, name="ckpt.write", retry_on=(OSError,))
+
+
+def _read_state_file(path: AnyPath, what: str) -> tp.Any:
+    """Read + unpickle, retrying transient IO; unpickling failures are
+    wrapped in a CheckpointError naming `what` instead of leaking a raw
+    pickle traceback as the only clue."""
+
+    def read() -> bytes:
+        chaos.fault_point("ckpt.load", path=str(path))
+        with open(path, "rb") as f:
+            return f.read()
+
+    payload = call_with_retry(read, name="ckpt.load", retry_on=(OSError,))
+    try:
+        return pickle.loads(payload)
+    except Exception as exc:
+        raise CheckpointError(
+            f"failed to unpickle {what} at {path}: "
+            f"{type(exc).__name__}: {exc}") from exc
 
 
 def save_state(state: tp.Any, path: AnyPath) -> None:
@@ -28,8 +75,7 @@ def save_state(state: tp.Any, path: AnyPath) -> None:
     `save_state_distributed`, which splits the collective gather from the
     rank-0 write."""
     host_state = to_numpy(state)
-    with write_and_rename(path, "wb") as f:
-        pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+    _write_state_file(path, host_state)
 
 
 def save_state_distributed(state: tp.Any, path: AnyPath) -> None:
@@ -41,16 +87,29 @@ def save_state_distributed(state: tp.Any, path: AnyPath) -> None:
     from . import distrib
     host_state = to_numpy(state)  # collective when leaves are sharded
     if distrib.is_rank_zero():
-        with write_and_rename(path, "wb") as f:
-            pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        _write_state_file(path, host_state)
 
 
 def load_state(path: AnyPath) -> tp.Any:
     """Load a state dict saved by `save_state`. Arrays come back as numpy;
     they are re-placed on device lazily when used in jitted computations
-    (or explicitly via `jax.device_put` with the target sharding)."""
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    (or explicitly via `jax.device_put` with the target sharding).
+
+    When the save left an integrity sidecar (saves do since the
+    resilience subsystem landed), the file is verified before
+    unpickling; mismatch raises `CheckpointCorrupted`. Unpickling
+    failures raise `CheckpointError` naming the path. A checkpoint
+    that simply does not exist stays a plain `FileNotFoundError` —
+    absence is not corruption.
+    """
+    if not Path(path).exists():
+        raise FileNotFoundError(f"No checkpoint at {path}")
+    problems = verify_file(path)
+    if problems:
+        raise CheckpointCorrupted(
+            f"single-file checkpoint {path} failed integrity verification: "
+            + "; ".join(problems))
+    return _read_state_file(path, "single-file checkpoint")
 
 
 class ArraySlot:
@@ -110,10 +169,15 @@ def _read_slot_pointer(directory: Path) -> tp.Optional[str]:
 
 
 def sharded_checkpoint_exists(directory: AnyPath) -> bool:
-    """True when `directory` holds a complete (committed) sharded save."""
+    """True when `directory` holds a committed sharded save that at least
+    one A/B slot could restore: the pointer must exist, but an active
+    slot whose payload went missing does not hide a restorable sibling
+    (restore falls back to it with a loud WARN)."""
     directory = Path(directory)
     slot = _read_slot_pointer(directory)
-    return slot is not None and (directory / slot / "state.pkl").exists()
+    if slot is None:
+        return False
+    return any((directory / s / "state.pkl").exists() for s in _SLOTS)
 
 
 def _prepare_slot(directory: Path) -> str:
@@ -125,9 +189,13 @@ def _prepare_slot(directory: Path) -> str:
     slot_dir = directory / target
     if distrib.is_rank_zero():
         slot_dir.mkdir(parents=True, exist_ok=True)
-        marker = slot_dir / "state.pkl"
-        if marker.exists():
-            marker.unlink()
+        # both the commit marker and the manifest: an aborted write must
+        # leave neither a "complete" look nor a stale integrity record
+        from .resilience.integrity import MANIFEST_NAME
+        for name in ("state.pkl", MANIFEST_NAME):
+            stale = slot_dir / name
+            if stale.exists():
+                stale.unlink()
     distrib.barrier("flashy_tpu_ckpt_slot")
     return target
 
@@ -135,18 +203,32 @@ def _prepare_slot(directory: Path) -> str:
 def _commit_slot(directory: Path, target: str, skeleton: tp.Any,
                  on_commit: tp.Optional[tp.Callable[[], None]] = None) -> None:
     """Make slot `target` the active checkpoint: write the skeleton (the
-    commit marker), then atomically flip the CURRENT pointer. Collective:
-    no rank returns before the flip is visible (a rank racing ahead
-    could read the OLD checkpoint as current). `on_commit` runs on every
-    rank after the flip — cleanup that must not precede durability."""
+    commit marker), then the integrity manifest, then atomically flip
+    the CURRENT pointer. Collective: no rank returns before the flip is
+    visible (a rank racing ahead could read the OLD checkpoint as
+    current). The manifest is written AFTER the all-payload barrier (so
+    it covers every host's Orbax shards) and BEFORE the flip (so an
+    active slot always carries one). `on_commit` runs on every rank
+    after the flip — cleanup that must not precede durability."""
     from . import distrib
     if distrib.is_rank_zero():
-        with write_and_rename(directory / target / "state.pkl", "wb") as f:
-            pickle.dump(skeleton, f, protocol=pickle.HIGHEST_PROTOCOL)
+        _write_state_file(directory / target / "state.pkl", skeleton,
+                          sidecar=False)
     distrib.barrier("flashy_tpu_ckpt_written")
     if distrib.is_rank_zero():
-        with write_and_rename(directory / _POINTER, "w") as f:
-            f.write(target)
+        def write_slot_manifest() -> None:
+            chaos.fault_point("ckpt.manifest", slot=target)
+            write_manifest(directory / target)
+
+        call_with_retry(write_slot_manifest, name="ckpt.manifest",
+                        retry_on=(OSError,))
+
+        def flip_pointer() -> None:
+            chaos.fault_point("ckpt.pointer", slot=target)
+            with write_and_rename(directory / _POINTER, "w") as f:
+                f.write(target)
+
+        call_with_retry(flip_pointer, name="ckpt.pointer", retry_on=(OSError,))
     distrib.barrier("flashy_tpu_ckpt_committed")
     if on_commit is not None:
         on_commit()
@@ -232,6 +314,26 @@ class AsyncShardedCheckpointer:
             self._checkpointer = None
 
 
+def _load_slot_skeleton(directory: Path, slot: str) -> tp.Any:
+    """Verify one slot against its manifest and unpickle its skeleton.
+
+    Raises CheckpointError (naming the slot and path) on integrity
+    mismatch, a missing commit marker, or an unpicklable skeleton —
+    the signal `load_state_sharded` uses to fall back to the sibling.
+    """
+    slot_dir = directory / slot
+    if not (slot_dir / "state.pkl").exists():
+        raise CheckpointError(f"slot {slot!r} of {directory} has no "
+                              "committed state.pkl")
+    problems = verify_slot(slot_dir)
+    if problems:
+        raise CheckpointError(
+            f"slot {slot!r} of {directory} failed integrity verification: "
+            + "; ".join(problems))
+    return _read_state_file(slot_dir / "state.pkl",
+                            f"slot {slot!r} skeleton")
+
+
 def load_state_sharded(directory: AnyPath, placements: tp.Any = None) -> tp.Any:
     """Restore a `save_state_sharded` checkpoint.
 
@@ -240,13 +342,70 @@ def load_state_sharded(directory: AnyPath, placements: tp.Any = None) -> tp.Any:
     restored by Orbax *directly onto their mesh placement* (each host
     reads only its shards). Leaves without a placement come back as host
     values. ALL processes must call this together.
+
+    Each slot is verified against its integrity manifest before
+    unpickling. When the ACTIVE slot is corrupt or unreadable, restore
+    falls back to the sibling A/B slot with a loud WARN (the run resumes
+    from the previous committed epoch — the checkpointed history rolls
+    back with it, so epoch numbering stays consistent); only when both
+    slots are bad does it raise `CheckpointCorrupted`.
     """
+    from . import distrib
     directory = Path(directory).absolute()
-    slot = _read_slot_pointer(directory)
-    if slot is None:
+    active = _read_slot_pointer(directory)
+    if active is None:
         raise FileNotFoundError(f"No committed sharded checkpoint in {directory}")
-    with open(directory / slot / "state.pkl", "rb") as f:
-        skeleton = pickle.load(f)
+    sibling = _SLOTS[1] if active == _SLOTS[0] else _SLOTS[0]
+    skeleton = None
+    # Slot selection (integrity hashing + skeleton unpickle) runs on
+    # rank 0 only: hashing every host's Orbax shards on every rank
+    # would read world_size x the full checkpoint off the shared FS at
+    # exactly the post-preemption moment it is busiest. The verdict is
+    # broadcast so all ranks restore the SAME slot.
+    verdict: tp.Optional[tp.Tuple[str, str]] = None
+    if distrib.is_rank_zero():
+        slot = active
+        errors: tp.List[str] = []
+        for candidate in (active, sibling):
+            try:
+                skeleton = _load_slot_skeleton(directory, candidate)
+                slot = candidate
+                break
+            except CheckpointError as exc:
+                errors.append(str(exc))
+                logger.warning(
+                    "checkpoint slot %r of %s is unreadable or corrupt: %s%s",
+                    candidate, directory, exc,
+                    " — falling back to the sibling A/B slot"
+                    if candidate == active else "")
+        verdict = ("ok", slot) if skeleton is not None \
+            else ("corrupt", " | ".join(errors))
+        if skeleton is not None and slot != active:
+            logger.warning(
+                "RESTORED FROM FALLBACK SLOT %r of %s: the active slot %r "
+                "was corrupt; the run resumes from the previously committed "
+                "epoch.", slot, directory, active)
+            # Repoint CURRENT at the slot that actually restored: the
+            # next save targets the NON-pointed slot, and without this
+            # flip it would overwrite the only good copy (this one)
+            # while the corrupt ex-active slot survived — a crash
+            # mid-save would then leave nothing restorable. Atomic,
+            # verified-good target.
+            with write_and_rename(directory / _POINTER, "w") as f:
+                f.write(slot)
+    if distrib.is_distributed():
+        verdict = distrib.broadcast_object(verdict)
+    assert verdict is not None
+    outcome, payload = verdict
+    if outcome == "corrupt":
+        raise CheckpointCorrupted(
+            f"no restorable checkpoint slot in {directory} "
+            "(both A/B slots failed): " + payload)
+    slot = payload
+    if skeleton is None:
+        # non-zero ranks: read the selected, already-verified slot
+        skeleton = _read_state_file(directory / slot / "state.pkl",
+                                    f"slot {slot!r} skeleton")
 
     slot_keys = [leaf.key for leaf in jax.tree_util.tree_leaves(
         skeleton, is_leaf=lambda x: isinstance(x, ArraySlot))
@@ -276,9 +435,16 @@ def load_state_sharded(directory: AnyPath, placements: tp.Any = None) -> tp.Any:
             else:
                 item[key] = 0
                 restore_args[key] = ocp.RestoreArgs()
-        with ocp.PyTreeCheckpointer() as checkpointer:
-            arrays = checkpointer.restore(directory / slot / "arrays",
-                                          item=item, restore_args=restore_args)
+        try:
+            with ocp.PyTreeCheckpointer() as checkpointer:
+                arrays = checkpointer.restore(directory / slot / "arrays",
+                                              item=item,
+                                              restore_args=restore_args)
+        except Exception as exc:
+            raise CheckpointError(
+                f"Orbax array restore failed for slot {slot!r} under "
+                f"{directory / slot / 'arrays'}: "
+                f"{type(exc).__name__}: {exc}") from exc
 
     def fill(leaf):
         return arrays[leaf.key] if isinstance(leaf, ArraySlot) else leaf

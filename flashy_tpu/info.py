@@ -74,7 +74,7 @@ def format_serve_status(status: dict) -> str:
     not know, so the snapshot schema can grow without breaking info.
     """
     parts = []
-    for key in ("requests", "completed", "rejected"):
+    for key in ("requests", "completed", "rejected", "expired"):
         if key in status:
             parts.append(f"{key}={int(status[key])}")
     for key in ("ttft_ms_p50", "ttft_ms_p95", "itl_ms_p50", "itl_ms_p95"):
@@ -83,6 +83,57 @@ def format_serve_status(status: dict) -> str:
     if "occupancy_p50" in status:
         parts.append(f"occupancy_p50={status['occupancy_p50'] * 100:.0f}%")
     return "  ".join(parts) or "(empty serve.json)"
+
+
+def format_verify_report(sig: str, report: dict) -> str:
+    """One-line view of a `resilience.verify_checkpoint` report.
+
+    Shows every checkpoint form found under the XP (single file, A/B
+    slots with the active one marked) and whether at least one verified
+    restore source remains.
+    """
+    parts = []
+    if report["single"] is not None:
+        parts.append("single=" + ("OK" if not report["single"] else "CORRUPT"))
+    for slot, problems in sorted(report["slots"].items()):
+        label = f"{slot}={'OK' if not problems else 'CORRUPT'}"
+        if slot == report.get("active"):
+            label += "*"
+        parts.append(label)
+    if not parts:
+        return f"{sig}  no checkpoints"
+    verdict = "restorable" if report["restorable"] else "NOT RESTORABLE"
+    line = f"{sig}  {' '.join(parts)}  -> {verdict}"
+    problems = list(report["single"] or [])
+    for slot_problems in report["slots"].values():
+        problems += slot_problems
+    for problem in problems:
+        line += f"\n  ! {problem}"
+    return line
+
+
+def verify_checkpoints(root: Path) -> int:
+    """Integrity-check every XP's checkpoints under `root`; returns the
+    process exit code: 1 when any XP has checkpoints but no verified
+    restore source left (the state an operator must act on), or when
+    `root` holds no experiments at all (matching the plain `info`
+    convention); 0 otherwise — XPs without checkpoints are fine."""
+    from .resilience import verify_checkpoint
+
+    xps_dir = root / "xps"
+    if not xps_dir.is_dir():
+        print(f"no experiments under {root}/xps")
+        return 1
+    bad = 0
+    for folder in sorted(xps_dir.iterdir()):
+        if not folder.is_dir():
+            continue
+        report = verify_checkpoint(folder)
+        print(format_verify_report(folder.name, report))
+        has_any = report["single"] is not None or report["slots"]
+        if has_any and not report["restorable"]:
+            bad += 1
+    return 1 if bad else 0
 
 
 def format_device_stats() -> str:
@@ -118,7 +169,15 @@ def main(argv=None) -> int:
     parser.add_argument("-d", "--devices", action="store_true",
                         help="also print live per-device memory stats for "
                              "this host (initializes the JAX backend)")
+    parser.add_argument("--verify-checkpoint", action="store_true",
+                        help="verify checkpoint integrity (sha256 manifests) "
+                             "for every XP; exit 1 when any XP's checkpoints "
+                             "have no restorable source left (or when no "
+                             "experiments exist under the root)")
     args = parser.parse_args(argv)
+
+    if args.verify_checkpoint:
+        return verify_checkpoints(Path(args.root))
 
     if args.devices:
         print(format_device_stats())

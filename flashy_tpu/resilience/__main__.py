@@ -1,0 +1,215 @@
+# The chaos drill — `python -m flashy_tpu.resilience` / `make
+# chaos-demo`, the acceptance gate of the fault-tolerance subsystem
+# (mirroring `python -m flashy_tpu.serve`'s role for serving). It runs
+# the same tiny deterministic training job twice: once clean, once
+# under injected faults — a transient IO failure on a history write
+# (must be absorbed by retry with zero training failures), a simulated
+# SIGTERM delivered mid-stage (must stop the run at a boundary with the
+# requeue exit code), and a corrupted active checkpoint slot (restore
+# must fall back to the sibling A/B slot) — then resumes and demands
+# the final history and metrics be IDENTICAL to the uninterrupted run.
+# Exit 1 unless resume is exact and every injected fault actually
+# fired and was recovered.
+"""`python -m flashy_tpu.resilience`: chaos drill proving resume-exactness."""
+import argparse
+import logging
+import shutil
+import sys
+import tempfile
+import typing as tp
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger("flashy_tpu.resilience.drill")
+
+DRILL_STEPS = 4  # fault-injectable steps per train stage
+
+
+def _drill_solver_class():
+    # Deferred so `python -m flashy_tpu.resilience --help` stays instant
+    # (importing the solver pulls in jax).
+    from ..solver import BaseSolver
+
+    class DrillSolver(BaseSolver):
+        """Tiny deterministic solver: numpy state, arithmetic updates.
+
+        Every metric is a pure function of the committed state and the
+        epoch number, so two runs that truly resume from the same
+        committed epoch produce bit-identical histories — the oracle
+        the drill compares against. `checkpoint_mode='sharded'` forces
+        the A/B slot + manifest path (numpy-only state keeps it pure
+        pickle, no accelerator required).
+        """
+
+        checkpoint_mode = "sharded"
+
+        def __init__(self, epochs: int):
+            super().__init__()
+            self.epochs = epochs
+            self.w = np.zeros(8)
+            self.register_stateful("w")
+
+        def train_stage(self):
+            from . import chaos
+            for step in range(DRILL_STEPS):
+                chaos.fault_point("drill.step", epoch=self.epoch, step=step)
+                self.w = self.w * 0.9 + 0.1 * self.epoch
+            return {"loss": float(np.sum(self.w))}
+
+        def valid_stage(self):
+            return {"score": float(np.mean(self.w) * self.epoch)}
+
+        def run(self):
+            self.restore()
+            for _ in range(self.epoch, self.epochs + 1):
+                self.run_stage("train", self.train_stage)
+                self.run_stage("valid", self.valid_stage)
+                self.commit()
+
+    return DrillSolver
+
+
+def _strip_wallclock(history: tp.List[dict]) -> tp.List[dict]:
+    """History with wall-clock-dependent keys removed: `duration` can
+    never match across runs; everything else must match exactly."""
+    return [{stage: {k: v for k, v in metrics.items() if k != "duration"}
+             for stage, metrics in epoch.items()} for epoch in history]
+
+
+def run_drill(epochs: int = 5, root: tp.Optional[str] = None,
+              preempt_epoch: int = 3, keep: bool = False,
+              log: tp.Optional[logging.Logger] = None) -> int:
+    """Run the chaos drill; returns 0 when every check passes.
+
+    Phase A: uninterrupted baseline. Phase B: the same job with a
+    transient history-write fault (epoch 2), a simulated SIGTERM
+    mid-train-stage of `preempt_epoch`, then a corrupted active slot.
+    Phase C: resume and compare against the baseline exactly.
+    """
+    from .. import resilience
+    from ..xp import Config, create_xp
+    from . import chaos
+
+    log = log or logger
+    if not 2 < preempt_epoch <= epochs:
+        # Two commits must land before the preemption so BOTH A/B slots
+        # are populated — corrupting the active one then proves fallback.
+        raise ValueError(f"preempt_epoch must be in (2, {epochs}], "
+                         f"got {preempt_epoch}")
+    workdir = Path(root) if root else Path(tempfile.mkdtemp(prefix="flashy_chaos_"))
+    DrillSolver = _drill_solver_class()
+    failures: tp.List[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        if ok:
+            log.info("PASS: %s", what)
+        else:
+            log.error("FAIL: %s", what)
+            failures.append(what)
+
+    try:
+        # -------------------------------------------------- baseline --
+        log.info("phase A: uninterrupted baseline (%d epochs)", epochs)
+        xp = create_xp(Config({"drill": "baseline"}), root=workdir)
+        with xp.enter():
+            baseline = DrillSolver(epochs)
+            baseline.run()
+        base_history = _strip_wallclock(baseline.history)
+        base_w = baseline.w.copy()
+
+        # ------------------------------------------- faulted run ------
+        log.info("phase B: chaos run — transient IO fault at the epoch-2 "
+                 "history write, simulated SIGTERM mid-train of epoch %d",
+                 preempt_epoch)
+        injector = chaos.install()
+        injector.fail_at("history.write", call=2)  # one transient hiccup
+        injector.preempt_at(
+            "drill.step", call=(preempt_epoch - 1) * DRILL_STEPS + 2)
+        chaos_cfg = Config({"drill": "chaos"})
+        xp = create_xp(chaos_cfg, root=workdir)
+        exit_code: tp.Optional[tp.Any] = None
+        with xp.enter():
+            solver = DrillSolver(epochs)
+            solver.enable_preemption_guard(install=False)
+            try:
+                solver.run()
+            except SystemExit as exc:
+                exit_code = exc.code
+        check(exit_code == resilience.EXIT_PREEMPTED,
+              f"preempted run exited with the requeue code "
+              f"{resilience.EXIT_PREEMPTED} (got {exit_code})")
+        check(len(solver.history) == preempt_epoch - 1,
+              f"preemption stopped at the boundary with exactly "
+              f"{preempt_epoch - 1} committed epochs "
+              f"(got {len(solver.history)})")
+        check(injector.hits("history.write", kind="fail") == 1,
+              "transient history-write fault fired and was absorbed by "
+              "retry (zero training failures)")
+        check(injector.hits("drill.step", kind="preempt") == 1,
+              "simulated mid-stage SIGTERM fired")
+
+        # ------------------------------------- corrupt the active slot
+        ckpt_dir = solver.sharded_checkpoint_path
+        slot = chaos.corrupt_active_slot(ckpt_dir)
+        log.info("phase B: corrupted active checkpoint slot %r", slot)
+
+        # ------------------------------------------------ resume ------
+        log.info("phase C: resume in the same XP (restore must fall back "
+                 "to the sibling slot)")
+        chaos.uninstall()
+        resilience.disable_preemption_guard()
+        xp = create_xp(chaos_cfg, root=workdir)  # same cfg -> same sig/folder
+        with xp.enter():
+            resumed = DrillSolver(epochs)
+            resumed.run()
+        check(_strip_wallclock(resumed.history) == base_history,
+              "resumed history/metrics identical to the uninterrupted run "
+              f"({len(resumed.history)} epochs)")
+        check(bool(np.array_equal(resumed.w, base_w)),
+              "resumed final model state bit-identical to the "
+              "uninterrupted run")
+        report = resilience.verify_checkpoint(resumed.folder)
+        check(report["restorable"],
+              "post-drill checkpoint verifies as restorable")
+    finally:
+        chaos.uninstall()
+        from .preemption import disable_preemption_guard
+        disable_preemption_guard()
+        if not keep and root is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+        elif keep:
+            log.info("artifacts kept under %s", workdir)
+
+    if failures:
+        log.error("chaos drill FAILED %d checks:\n  %s", len(failures),
+                  "\n  ".join(failures))
+        return 1
+    log.info("chaos drill passed: preemption, retry and corrupted-slot "
+             "fallback all recovered; resume was exact.")
+    return 0
+
+
+def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flashy_tpu.resilience",
+        description="Chaos drill: inject preemption + IO + corruption "
+                    "faults and prove resume-exactness.")
+    parser.add_argument("-e", "--epochs", type=int, default=5)
+    parser.add_argument("--preempt-epoch", type=int, default=3,
+                        help="epoch whose train stage takes the simulated "
+                             "SIGTERM (must be > 2 so both A/B slots exist)")
+    parser.add_argument("--dir", default=None,
+                        help="work directory (default: a fresh temp dir)")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the XP folders for inspection")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="[%(levelname)s] %(message)s")
+    return run_drill(epochs=args.epochs, root=args.dir,
+                     preempt_epoch=args.preempt_epoch, keep=args.keep)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,204 @@
+# Deterministic fault injection. Recovery code that is never executed
+# is broken code you have not met yet; the FaultInjector makes every
+# recovery path in this subsystem a first-class, repeatable test
+# subject. The framework's IO sites call `fault_point("site")` (a no-op
+# costing one None-check when no injector is installed); a test or the
+# chaos drill installs an injector with site-keyed rules — fail the Nth
+# write with an OSError, deliver a simulated SIGTERM at the Kth step,
+# run an arbitrary action — and afterwards reads `injector.fired` to
+# prove the fault actually happened (a chaos drill whose faults never
+# fired proves nothing).
+"""FaultInjector: site-keyed, deterministic fault injection hooks."""
+from pathlib import Path
+import dataclasses
+import json
+import logging
+import time
+import typing as tp
+
+from ..utils import AnyPath
+
+logger = logging.getLogger(__name__)
+
+
+class InjectedFault(OSError):
+    """The default exception an injection rule raises.
+
+    Derives from OSError so the retry layer treats it as the transient
+    IO failure it simulates — injecting through the exact exception
+    class the real failure would use keeps the drill honest.
+    """
+
+
+@dataclasses.dataclass
+class _Rule:
+    site: str
+    first_call: int           # 1-based occurrence that triggers the rule
+    times: int                # consecutive occurrences it stays armed for
+    action: tp.Callable[[], None]
+    kind: str                 # 'fail' | 'preempt' | 'act' (for the log)
+
+    def armed_for(self, call: int) -> bool:
+        return self.first_call <= call < self.first_call + self.times
+
+
+class FaultInjector:
+    """Site-keyed deterministic fault rules + a record of what fired.
+
+    `counts` tallies every occurrence of every site (whether or not a
+    rule fired), `fired` records each triggered fault — the evidence a
+    chaos drill checks to assert its faults were actually exercised.
+    """
+
+    def __init__(self) -> None:
+        self.counts: tp.Dict[str, int] = {}
+        self.fired: tp.List[tp.Dict[str, tp.Any]] = []
+        self._rules: tp.List[_Rule] = []
+
+    # ------------------------------------------------------------------
+    # arming rules
+    # ------------------------------------------------------------------
+    def fail_at(self, site: str, call: int, times: int = 1,
+                exc: tp.Optional[tp.Callable[[], BaseException]] = None) -> None:
+        """Raise at the `call`-th occurrence of `site` (`times` in a row).
+
+        `exc` builds the exception (default: `InjectedFault`, an
+        OSError, so retry allowlists treat it as transient). Set
+        `times` >= the retry budget to simulate a persistent failure.
+        """
+        build = exc or (lambda: InjectedFault(f"injected fault at {site}"))
+
+        def action() -> None:
+            raise build()
+
+        self._rules.append(_Rule(site, call, times, action, "fail"))
+
+    def preempt_at(self, site: str, call: int) -> None:
+        """Deliver a simulated SIGTERM (via the active PreemptionGuard)
+        at the `call`-th occurrence of `site` — the cloud preemption
+        notice, minus the cloud."""
+
+        def action() -> None:
+            from .preemption import get_preemption_guard
+            guard = get_preemption_guard()
+            if guard is None:
+                raise RuntimeError(
+                    f"preempt_at({site!r}) fired but no PreemptionGuard is "
+                    "enabled; call enable_preemption_guard() first.")
+            guard.simulate_signal()
+
+        self._rules.append(_Rule(site, call, 1, action, "preempt"))
+
+    def act_at(self, site: str, call: int, action: tp.Callable[[], None],
+               times: int = 1) -> None:
+        """Run an arbitrary `action` at the `call`-th occurrence of `site`."""
+        self._rules.append(_Rule(site, call, times, action, "act"))
+
+    # ------------------------------------------------------------------
+    # the hook
+    # ------------------------------------------------------------------
+    def tick(self, site: str, **context: tp.Any) -> None:
+        """One occurrence of `site`: count it and fire any armed rule."""
+        call = self.counts.get(site, 0) + 1
+        self.counts[site] = call
+        for rule in self._rules:
+            if rule.site == site and rule.armed_for(call):
+                self.fired.append({"site": site, "call": call,
+                                   "kind": rule.kind, **context})
+                logger.info("chaos: firing %s fault at %s (occurrence %d)",
+                            rule.kind, site, call)
+                rule.action()
+
+    def hits(self, site: tp.Optional[str] = None,
+             kind: tp.Optional[str] = None) -> int:
+        """How many faults fired (optionally filtered by site/kind)."""
+        return sum(1 for f in self.fired
+                   if (site is None or f["site"] == site)
+                   and (kind is None or f["kind"] == kind))
+
+
+_injector: tp.Optional[FaultInjector] = None
+
+
+def install(injector: tp.Optional[FaultInjector] = None) -> FaultInjector:
+    """Install a process-wide FaultInjector (building one if not given).
+
+    Every framework `fault_point` site starts consulting it. Tests
+    should pair this with `uninstall()` (or use it via fixture teardown).
+    """
+    global _injector
+    _injector = injector or FaultInjector()
+    return _injector
+
+
+def uninstall() -> None:
+    """Remove the process-wide injector; all sites become no-ops again."""
+    global _injector
+    _injector = None
+
+
+def get_injector() -> tp.Optional[FaultInjector]:
+    return _injector
+
+
+def fault_point(site: str, **context: tp.Any) -> None:
+    """Framework-side hook: a named site where faults can be injected.
+
+    Costs one None-check when no injector is installed, so it is safe
+    to leave in production IO paths. Sites in the framework today:
+    ``ckpt.write`` (single-file + slot state pickles), ``ckpt.manifest``,
+    ``ckpt.pointer``, ``ckpt.load``, ``history.write``,
+    ``logger.<backend>`` (per-backend metric fan-out), and the chaos
+    drill's ``drill.step``.
+    """
+    if _injector is not None:
+        _injector.tick(site, **context)
+
+
+# ----------------------------------------------------------------------
+# direct-action helpers (corruption + stalls are states, not call sites)
+# ----------------------------------------------------------------------
+def corrupt_file(path: AnyPath, offset: int = 0, nbytes: int = 8) -> None:
+    """Flip `nbytes` of `path` in place starting at `offset` — the
+    torn/bit-rotted write a checksum manifest exists to catch."""
+    path = Path(path)
+    data = bytearray(path.read_bytes())
+    if not data:
+        data = bytearray(b"\0")
+    end = min(len(data), offset + max(nbytes, 1))
+    for i in range(offset, end):
+        data[i] ^= 0xFF
+    path.write_bytes(bytes(data))
+
+
+def corrupt_active_slot(directory: AnyPath, filename: str = "state.pkl") -> str:
+    """Corrupt the ACTIVE slot of a sharded checkpoint directory (the
+    one the CURRENT pointer names); returns the slot name. The sibling
+    slot is left intact — exactly the state the A/B fallback exists for.
+    """
+    from ..checkpoint import _read_slot_pointer
+    directory = Path(directory)
+    slot = _read_slot_pointer(directory)
+    if slot is None:
+        raise FileNotFoundError(f"no committed slot pointer in {directory}")
+    corrupt_file(directory / slot / filename, offset=1)
+    logger.info("chaos: corrupted %s of active slot %s", filename, slot)
+    return slot
+
+
+def stall_heartbeat(folder: AnyPath, rank: int, age: float) -> Path:
+    """Rewrite rank `rank`'s heartbeat file as if it last beat `age`
+    seconds ago — the signature of a hung (not crashed) process, which
+    the HangWatchdog exists to catch."""
+    from ..observability.heartbeat import HEARTBEAT_PREFIX
+    path = Path(folder) / f"{HEARTBEAT_PREFIX}{rank}.json"
+    payload: tp.Dict[str, tp.Any] = {"rank": rank, "world_size": rank + 1}
+    if path.exists():
+        try:
+            payload = json.loads(path.read_text())
+        except json.JSONDecodeError:
+            pass
+    payload["time"] = time.time() - age
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, default=float))
+    return path

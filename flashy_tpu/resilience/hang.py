@@ -1,0 +1,140 @@
+# Hang watchdog. A pod that CRASHES is cheap — the scheduler requeues
+# it. A pod that HANGS (one rank stuck in a collective, everyone else
+# waiting at the same collective) bills every accelerator-hour until a
+# human notices. The PR 1 heartbeats already leave per-rank liveness
+# files on the shared filesystem precisely because a hung pod cannot
+# run a collective to report itself; this monitor reads them from a
+# side thread (which still runs while the main thread is stuck in XLA),
+# WARNs with a straggler report when any rank stalls past a threshold,
+# and optionally aborts the process — turning the expensive failure
+# mode (silent hang) into the cheap one (loud crash + requeue).
+"""HangWatchdog: WARN, then optionally abort, on stalled heartbeats."""
+from pathlib import Path
+import logging
+import os
+import threading
+import typing as tp
+
+from ..observability.heartbeat import format_straggler_report, straggler_report
+from ..utils import AnyPath
+
+logger = logging.getLogger(__name__)
+
+# EX_SOFTWARE: "internal software error" — distinct from the preemption
+# guard's EX_TEMPFAIL(75) so the requeue wrapper can tell "stop and
+# resubmit me" from "I aborted a hung pod".
+EXIT_HUNG = 70
+
+
+def _default_abort(exit_code: int, report: tp.Dict[str, tp.Any]) -> None:
+    """Kill the process immediately. `os._exit`, not `sys.exit`: the
+    main thread is presumed stuck in a collective and will never unwind
+    a SystemExit raised on this monitor thread."""
+    del report
+    os._exit(exit_code)
+
+
+class HangWatchdog:
+    """Monitors per-rank heartbeat files for stalls.
+
+    Args:
+        folder: the heartbeat directory (`<xp.folder>/heartbeats`).
+        warn_after: seconds of heartbeat staleness before a rank is
+            reported as stalled (WARN + straggler report).
+        abort_after: optional; past this staleness the watchdog calls
+            `on_abort` (default: `os._exit(EXIT_HUNG)`) with the report.
+            None (default) = warn-only.
+        interval: background polling period for `start()`.
+        on_warn / on_abort: injectable for tests and custom policy
+            (e.g. paging instead of aborting).
+
+    `check()` is the one-shot core (pure read; callable from anywhere,
+    including `python -m flashy_tpu.info` tooling); `start()`/`stop()`
+    run it on a daemon thread.
+    """
+
+    def __init__(self, folder: AnyPath, warn_after: float = 120.0,
+                 abort_after: tp.Optional[float] = None,
+                 interval: float = 10.0,
+                 exit_code: int = EXIT_HUNG,
+                 on_warn: tp.Optional[tp.Callable[[str], None]] = None,
+                 on_abort: tp.Optional[
+                     tp.Callable[[int, tp.Dict[str, tp.Any]], None]] = None):
+        if abort_after is not None and abort_after < warn_after:
+            raise ValueError(f"abort_after ({abort_after}) must be >= "
+                             f"warn_after ({warn_after})")
+        self.folder = Path(folder)
+        self.warn_after = warn_after
+        self.abort_after = abort_after
+        self.interval = interval
+        self.exit_code = exit_code
+        self.on_warn = on_warn or (lambda msg: logger.warning(msg))
+        self.on_abort = on_abort or _default_abort
+        self._warned: tp.Set[int] = set()
+        self._stop = threading.Event()
+        self._thread: tp.Optional[threading.Thread] = None
+
+    def check(self, now: tp.Optional[float] = None) -> tp.Dict[str, tp.Any]:
+        """One inspection pass. Returns the straggler report extended
+        with `stalled` (ranks past warn_after) and `action`
+        (None | 'warn' | 'abort'). WARNs once per rank per stall episode
+        (a rank that resumes beating re-arms its warning)."""
+        import time as _time
+        now = _time.time() if now is None else now
+        report = straggler_report(self.folder, now=now)
+        report["stalled"] = []
+        report["action"] = None
+        if not report.get("ranks"):
+            return report
+        # straggler_report only exposes the stalest age; the watchdog
+        # needs per-rank staleness for the full stalled set.
+        stalled = []
+        for beat in report["per_rank"]:
+            age = now - beat["time"] if "time" in beat else 0.0
+            if age > self.warn_after:
+                stalled.append((beat.get("rank", 0), age))
+        report["stalled"] = [rank for rank, _ in stalled]
+        fresh = [r for r in report["stalled"] if r not in self._warned]
+        self._warned.intersection_update(report["stalled"])
+        if fresh:
+            report["action"] = "warn"
+            self._warned.update(fresh)
+            self.on_warn(
+                f"hang watchdog: rank(s) {fresh} heartbeat stalled past "
+                f"{self.warn_after:.0f}s — {format_straggler_report(report)}")
+        if (self.abort_after is not None and stalled
+                and max(age for _, age in stalled) > self.abort_after):
+            report["action"] = "abort"
+            logger.critical(
+                "hang watchdog: aborting (exit %d): rank(s) %s stalled past "
+                "%.0fs — %s", self.exit_code, report["stalled"],
+                self.abort_after, format_straggler_report(report))
+            self.on_abort(self.exit_code, report)
+        return report
+
+    # ------------------------------------------------------------------
+    # background thread
+    # ------------------------------------------------------------------
+    def start(self) -> "HangWatchdog":
+        """Poll `check()` every `interval` seconds on a daemon thread."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="flashy-hang-watchdog",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.check()
+            except Exception:
+                logger.exception("hang watchdog check failed (continuing)")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 1.0)
+            self._thread = None

@@ -1,0 +1,190 @@
+# Preemption guard. Cloud TPU preemptions deliver SIGTERM with ~30s of
+# notice; today that notice is ignored and the run dies wherever it
+# happens to be — possibly mid-collective, possibly with an async
+# checkpoint half-written. The guard turns the signal into a
+# *cooperative, pod-consistent* stop: the handler only sets a flag
+# (signal handlers must not run collectives or IO), and all ranks agree
+# on the flag at step/stage boundaries through one cheap distrib
+# reduction — one host's signal stops the WHOLE pod at the same
+# boundary, because a single rank unilaterally skipping a collective
+# deadlocks everyone else. The solver then finishes or abandons the
+# in-flight stage per config, finalizes any in-flight async checkpoint,
+# and exits with a requeue-friendly exit code (EX_TEMPFAIL).
+"""PreemptionGuard: SIGTERM/SIGINT -> cooperative pod-consistent stop."""
+import logging
+import signal
+import threading
+import typing as tp
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# 75 = EX_TEMPFAIL ("temporary failure; user is invited to retry"): the
+# exit code schedulers/requeue wrappers key on to resubmit instead of
+# marking the job failed. Distinct from any python default (1, 2, 130).
+EXIT_PREEMPTED = 75
+
+
+class PreemptionInterrupt(BaseException):
+    """Raised at a cooperative boundary once all ranks agreed to stop.
+
+    Derives from BaseException (like KeyboardInterrupt) so a stage's
+    `except Exception` error handling cannot accidentally swallow the
+    pod-wide stop decision.
+    """
+
+
+class PreemptionGuard:
+    """Cooperative preemption flag with pod-wide agreement.
+
+    * The signal handler (SIGTERM/SIGINT by default) only sets a local
+      flag — safe in any context. A second delivery of the same signal
+      restores the previous handler and re-raises it, so an operator's
+      double Ctrl-C still force-kills a stuck run.
+    * `should_stop()` is the COLLECTIVE agreement: every rank
+      contributes its local flag through one max-reduction and all see
+      the same verdict. It must therefore be called at points every
+      rank reaches the same number of times (stage boundaries, commit
+      boundaries, every-N-steps) — never from rank-gated code.
+    * The verdict is sticky: once the pod agreed to stop, it stays
+      agreed (a requeue should not "un-preempt" mid-epilogue).
+
+    `simulate_signal()` sets the same local flag the handler would —
+    the hook the FaultInjector's `preempt_at` uses, so preemption
+    handling is testable without process-level signal delivery.
+    """
+
+    def __init__(self, signals: tp.Sequence[int] = (signal.SIGTERM, signal.SIGINT),
+                 exit_code: int = EXIT_PREEMPTED):
+        self.signals = tuple(signals)
+        self.exit_code = exit_code
+        self._requested = False
+        self._agreed = False
+        self._signal_name: tp.Optional[str] = None
+        self._previous: tp.Dict[int, tp.Any] = {}
+        self._check_calls = 0
+
+    # ------------------------------------------------------------------
+    # signal plumbing (local, per-process)
+    # ------------------------------------------------------------------
+    def install(self) -> bool:
+        """Register the handlers; returns False when not possible (only
+        the main thread may set signal handlers — e.g. under a test
+        runner thread the guard still works via `simulate_signal`)."""
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning("PreemptionGuard.install() outside the main "
+                           "thread: signal handlers not registered "
+                           "(simulate_signal still works).")
+            return False
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+        return True
+
+    def uninstall(self) -> None:
+        """Restore the previous signal handlers."""
+        for sig, previous in self._previous.items():
+            signal.signal(sig, previous)
+        self._previous.clear()
+
+    def _handle(self, signum: int, frame: tp.Any) -> None:
+        del frame
+        if self._requested:
+            # Second delivery: the operator (or platform) means it.
+            # Restore the original disposition and re-deliver.
+            logger.warning("preemption: second signal %d — restoring the "
+                           "default handler and re-raising.", signum)
+            self.uninstall()
+            signal.raise_signal(signum)
+            return
+        self._requested = True
+        self._signal_name = signal.Signals(signum).name
+        logger.warning(
+            "preemption: received %s; the run will stop cooperatively at "
+            "the next stage/commit boundary (signal again to force-kill).",
+            self._signal_name)
+
+    def simulate_signal(self, name: str = "SIMULATED") -> None:
+        """Set the local preemption flag as a real signal would."""
+        if not self._requested:
+            self._requested = True
+            self._signal_name = name
+            logger.warning("preemption: simulated signal %s; stopping at "
+                           "the next boundary.", name)
+
+    @property
+    def requested(self) -> bool:
+        """This process' local flag (no collective; may differ per rank
+        until the next `should_stop` agreement)."""
+        return self._requested
+
+    @property
+    def signal_name(self) -> tp.Optional[str]:
+        return self._signal_name
+
+    # ------------------------------------------------------------------
+    # pod-wide agreement (collective)
+    # ------------------------------------------------------------------
+    def should_stop(self) -> bool:
+        """Do ALL ranks agree the pod must stop? COLLECTIVE: every rank
+        must call this at the same boundary (same call count), or the
+        reduction itself deadlocks. Sticky once True."""
+        if self._agreed:
+            return True
+        from .. import distrib  # lazy: the guard is importable pre-init
+        if distrib.is_distributed():
+            flag = int(distrib.all_reduce(
+                np.array(int(self._requested), np.int32), "max"))
+        else:
+            flag = int(self._requested)
+        if flag:
+            self._agreed = True
+            logger.warning("preemption: pod-wide stop agreed "
+                           "(local signal: %s).", self._signal_name or "peer")
+        return self._agreed
+
+    def check(self, every: int = 1) -> bool:
+        """Step-loop variant of `should_stop`, throttled by CALL COUNT
+        (never wall time: a time throttle would desynchronize the
+        collective across ranks). All ranks run step loops in lockstep,
+        so counting calls keeps the reduction aligned."""
+        self._check_calls += 1
+        if self._agreed:
+            return True
+        if every > 1 and self._check_calls % every:
+            return False
+        return self.should_stop()
+
+
+_current: tp.Optional[PreemptionGuard] = None
+
+
+def enable_preemption_guard(install: bool = True,
+                            **kwargs: tp.Any) -> PreemptionGuard:
+    """Create (and install) the process-wide PreemptionGuard.
+
+    Mirrors `observability.enable_telemetry`: one switch, a module
+    global the rest of the framework consults. Calling again replaces
+    the previous guard (uninstalling its handlers). See
+    `BaseSolver.enable_preemption_guard` for the solver-side wiring.
+    """
+    global _current
+    if _current is not None:
+        _current.uninstall()
+    _current = PreemptionGuard(**kwargs)
+    if install:
+        _current.install()
+    return _current
+
+
+def disable_preemption_guard() -> None:
+    """Uninstall and forget the process-wide guard."""
+    global _current
+    if _current is not None:
+        _current.uninstall()
+    _current = None
+
+
+def get_preemption_guard() -> tp.Optional[PreemptionGuard]:
+    """The process-wide guard, or None when disabled (the default)."""
+    return _current

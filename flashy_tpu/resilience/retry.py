@@ -1,0 +1,126 @@
+# Retry with exponential backoff. At pod scale the dominant IO failure
+# is transient — a GCS 503, an NFS attribute-cache hiccup, a wandb API
+# timeout — and today any one of them kills a run that would have
+# succeeded 100ms later. The rule encoded here: retry only exceptions
+# the caller declared transient (an allowlist, never bare Exception for
+# critical paths), back off exponentially with jitter so a pod's worth
+# of ranks does not hammer the recovering service in lockstep, count
+# every attempt through the telemetry Tracer (PR 1) so retries are
+# visible post-mortem, and choose per site whether exhaustion raises
+# (checkpoint writes: losing durability is fatal) or degrades to a
+# warning (metric logging backends: losing a wandb point is not).
+"""retry/backoff: decorator + call helper for transient-failure IO."""
+import functools
+import logging
+import random
+import time
+import typing as tp
+
+logger = logging.getLogger(__name__)
+
+# One module-level PRNG for jitter: reseeding per call would correlate
+# the very ranks the jitter exists to decorrelate.
+_jitter_rng = random.Random()
+
+# Module-level so tests can stub the wait out; `sleep=None` arguments
+# resolve here at call time.
+_sleep = time.sleep
+
+
+def backoff_delay(attempt: int, base_delay: float, max_delay: float,
+                  jitter: float, rng: tp.Optional[random.Random] = None) -> float:
+    """Delay before retry number `attempt` (1-based): exponential growth
+    capped at `max_delay`, plus up to `jitter` fraction of random extra
+    so concurrent ranks spread their retries instead of stampeding."""
+    delay = min(base_delay * (2 ** (attempt - 1)), max_delay)
+    if jitter > 0.0:
+        delay *= 1.0 + (rng or _jitter_rng).random() * jitter
+    return delay
+
+
+def _note_attempt(name: str, attempt: int, attempts: int,
+                  error: BaseException, outcome: str) -> None:
+    """Journal one failed attempt through the telemetry tracer (when
+    enabled) so retries are reconstructible post-mortem."""
+    from ..observability import get_telemetry  # lazy: avoids an import cycle
+    telemetry = get_telemetry()
+    if telemetry is not None:
+        telemetry.record({"type": "retry", "site": name, "attempt": attempt,
+                          "attempts": attempts, "outcome": outcome,
+                          "error": f"{type(error).__name__}: {error}"})
+
+
+def call_with_retry(fn: tp.Callable, *args: tp.Any,
+                    attempts: int = 4,
+                    base_delay: float = 0.1,
+                    max_delay: float = 5.0,
+                    jitter: float = 0.5,
+                    retry_on: tp.Tuple[tp.Type[BaseException], ...] = (OSError,),
+                    name: tp.Optional[str] = None,
+                    on_exhausted: str = "raise",
+                    sleep: tp.Optional[tp.Callable[[float], None]] = None,
+                    **kwargs: tp.Any) -> tp.Any:
+    """Call `fn(*args, **kwargs)`, retrying declared-transient failures.
+
+    Only exceptions matching `retry_on` are retried — anything else
+    (a pickle error, a ValueError) is a bug or corruption, not a
+    transient, and propagates immediately. After `attempts` total tries:
+    `on_exhausted='raise'` re-raises the last error (critical IO),
+    `'warn'` logs a warning and returns None (best-effort IO such as
+    metric logging backends). Every failed attempt is WARNed and
+    journaled through the active telemetry Tracer as a `retry` record.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    if on_exhausted not in ("raise", "warn"):
+        raise ValueError(f"on_exhausted must be 'raise' or 'warn', "
+                         f"got {on_exhausted!r}")
+    site = name or getattr(fn, "__qualname__", repr(fn))
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as exc:
+            last = attempt == attempts
+            _note_attempt(site, attempt, attempts, exc,
+                          "exhausted" if last else "retrying")
+            if last:
+                if on_exhausted == "warn":
+                    logger.warning(
+                        "%s failed %d/%d attempts; degrading to a warning "
+                        "(last error: %s)", site, attempt, attempts, exc)
+                    return None
+                raise
+            delay = backoff_delay(attempt, base_delay, max_delay, jitter)
+            logger.warning("%s failed (attempt %d/%d): %s — retrying in %.2fs",
+                           site, attempt, attempts, exc, delay)
+            (sleep or _sleep)(delay)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def retry(attempts: int = 4, base_delay: float = 0.1, max_delay: float = 5.0,
+          jitter: float = 0.5,
+          retry_on: tp.Tuple[tp.Type[BaseException], ...] = (OSError,),
+          name: tp.Optional[str] = None, on_exhausted: str = "raise",
+          sleep: tp.Optional[tp.Callable[[float], None]] = None) -> tp.Callable:
+    """Decorator form of `call_with_retry`::
+
+        @retry(retry_on=(OSError,), name="ckpt.write")
+        def write(...): ...
+
+    The wrapped unit must be idempotent (atomic write-and-rename IO is;
+    anything containing a cross-rank collective is NOT — a rank retrying
+    a collective alone deadlocks the pod, so never wrap one).
+    """
+
+    def decorator(fn: tp.Callable) -> tp.Callable:
+        @functools.wraps(fn)
+        def wrapped(*args: tp.Any, **kwargs: tp.Any) -> tp.Any:
+            return call_with_retry(
+                fn, *args, attempts=attempts, base_delay=base_delay,
+                max_delay=max_delay, jitter=jitter, retry_on=retry_on,
+                name=name or getattr(fn, "__qualname__", repr(fn)),
+                on_exhausted=on_exhausted, sleep=sleep, **kwargs)
+
+        return wrapped
+
+    return decorator

@@ -36,6 +36,7 @@ class ServeMetrics:
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
+        self.expired = 0
         self.tokens = 0
         self.finish_reasons: tp.Dict[str, int] = {}
         self.ttft: tp.List[float] = []
@@ -52,6 +53,12 @@ class ServeMetrics:
 
     def on_reject(self) -> None:
         self.rejected += 1
+
+    def on_expired(self) -> None:
+        """A queued request shed past its TTL deadline (never ran)."""
+        self.expired += 1
+        self.finish_reasons["expired"] = \
+            self.finish_reasons.get("expired", 0) + 1
 
     def on_first_token(self, ttft_seconds: float) -> None:
         self.ttft.append(ttft_seconds)
@@ -85,6 +92,7 @@ class ServeMetrics:
             "requests": self.submitted,
             "completed": self.completed,
             "rejected": self.rejected,
+            "expired": self.expired,
             "tokens": self.tokens,
         }
         for name, samples, scale in (("ttft_ms", self.ttft, 1e3),

@@ -48,9 +48,10 @@ class Request:
     slot: tp.Optional[int] = None
     generated: tp.List[int] = dataclasses.field(default_factory=list)
     submitted_at: float = 0.0
+    deadline: tp.Optional[float] = None  # absolute; None = no TTL
     first_token_at: tp.Optional[float] = None
     finished_at: tp.Optional[float] = None
-    finish_reason: tp.Optional[str] = None  # 'eos' | 'length'
+    finish_reason: tp.Optional[str] = None  # 'eos' | 'length' | 'expired'
 
     @property
     def done(self) -> bool:
@@ -106,40 +107,91 @@ class ContinuousBatchingScheduler:
         return not self._queue and not self._running
 
     def submit(self, prompt: tp.Any, max_new_tokens: int,
-               eos_token: tp.Optional[int] = None) -> Request:
+               eos_token: tp.Optional[int] = None,
+               ttl: tp.Optional[float] = None) -> Request:
         """Queue one request; returns its Request handle.
 
         Raises QueueFull at the depth cap and ValueError for requests
-        that could never fit the cache (so an impossible request fails
-        at the door, not after queueing behind everyone else).
+        that could never fit the cache — a prompt longer than the
+        largest prefill bucket, or `prompt + max_new_tokens` beyond
+        `max_seq_len` — so an impossible request fails at the door, not
+        mid-decode after queueing behind everyone else and occupying a
+        slot. `ttl` (seconds) is an optional queue-wait budget: a
+        request still queued past its deadline is shed with
+        `finish_reason='expired'` instead of being prefilled after the
+        client stopped waiting for it.
         """
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size < 1:
             raise ValueError(f"prompt must be 1-D non-empty, got {prompt.shape}")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        largest_bucket = self.engine.bucket_for(self.engine.max_seq_len)
+        if prompt.size > largest_bucket:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the largest prefill "
+                f"bucket ({largest_bucket}); it can never be prefilled")
         total = prompt.size + max_new_tokens
         if total > self.engine.max_seq_len:
             raise ValueError(
                 f"prompt + max_new_tokens = {total} exceeds the engine's "
                 f"max_seq_len {self.engine.max_seq_len}")
+        if ttl is not None and ttl <= 0:
+            raise ValueError(f"ttl must be positive (seconds), got {ttl}")
         if len(self._queue) >= self.max_queue:
             self.metrics.on_reject()
             raise QueueFull(
                 f"admission queue is at capacity ({self.max_queue}); "
                 f"retry after in-flight requests drain")
+        now = time.perf_counter()
         request = Request(uid=next(self._uid), prompt=prompt,
                           max_new_tokens=max_new_tokens, eos_token=eos_token,
-                          submitted_at=time.perf_counter())
+                          submitted_at=now,
+                          deadline=now + ttl if ttl is not None else None)
         self._queue.append(request)
         self.metrics.on_submit()
         return request
+
+    def _shed_expired(self, now: tp.Optional[float] = None) -> int:
+        """Drop queued requests whose TTL deadline passed; returns #shed.
+
+        Expired requests finish as 'expired' without ever touching a
+        slot — prefilling work the client already abandoned would only
+        delay the requests still waiting.
+        """
+        if not any(r.deadline is not None for r in self._queue):
+            return 0
+        now = time.perf_counter() if now is None else now
+        kept: tp.Deque[Request] = collections.deque()
+        shed = 0
+        for request in self._queue:
+            if request.deadline is not None and now >= request.deadline:
+                request.state = "done"
+                request.finish_reason = "expired"
+                request.finished_at = now
+                self.metrics.on_expired()
+                shed += 1
+                logger.debug("request %d expired after %.3fs in queue",
+                             request.uid, now - request.submitted_at)
+            else:
+                kept.append(request)
+        self._queue = kept
+        return shed
 
     def _admit(self) -> int:
         """Prefill queued requests into free slots; returns #admitted."""
         admitted = 0
         while self._queue and self.engine.free_count:
             request = self._queue.popleft()
+            if (request.deadline is not None
+                    and time.perf_counter() >= request.deadline):
+                # expired while earlier admissions in this very step were
+                # prefilling: shed at the door, never occupy the slot.
+                request.state = "done"
+                request.finish_reason = "expired"
+                request.finished_at = time.perf_counter()
+                self.metrics.on_expired()
+                continue
             slot = self.engine.acquire_slot()
             assert slot is not None
             first = self.engine.prefill(slot, request.prompt)
@@ -174,7 +226,9 @@ class ContinuousBatchingScheduler:
                      len(request.generated))
 
     def step(self) -> int:
-        """Admit + one decode step + retire; returns #tokens emitted."""
+        """Shed expired + admit + one decode step + retire; returns
+        #tokens emitted."""
+        self._shed_expired()
         self._admit()
         self.metrics.on_gauges(queue_depth=len(self._queue),
                                live=self.engine.live_count,

@@ -186,8 +186,19 @@ class Link:
 
     def update_history(self, history: tp.List[tp.Dict[str, tp.Any]]) -> None:
         self.history = list(history)
-        with write_and_rename(self.history_path, "w") as f:
-            json.dump(self.history, f, indent=2, default=float)
+
+        # Retried: one transient GCS/NFS hiccup on this write must not
+        # kill a pod-scale run (the atomic write-and-rename is
+        # idempotent, so retrying is safe); a persistent failure still
+        # raises — silently losing the history would break resume.
+        def write() -> None:
+            from .resilience import chaos
+            chaos.fault_point("history.write", path=str(self.history_path))
+            with write_and_rename(self.history_path, "w") as f:
+                json.dump(self.history, f, indent=2, default=float)
+
+        from .resilience.retry import call_with_retry
+        call_with_retry(write, name="history.write", retry_on=(OSError,))
 
 
 @dataclass

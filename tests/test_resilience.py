@@ -1,0 +1,565 @@
+# Tests for the fault-tolerance subsystem — every recovery path is
+# exercised through the deterministic FaultInjector, never by hoping a
+# real failure shows up: retry-then-succeed on transient IO, commit
+# rollback on persistent save failure, manifest verification +
+# corrupted-active-slot fallback to the sibling A/B slot, preemption
+# resume-exactness, logging backends degrading to warnings, and the
+# hang watchdog firing on a stalled heartbeat.
+import json
+import logging
+import pickle
+import signal
+
+import numpy as np
+import pytest
+
+from flashy_tpu import checkpoint as ckpt
+from flashy_tpu import resilience
+from flashy_tpu.resilience import chaos
+from flashy_tpu.resilience.retry import backoff_delay, call_with_retry
+from flashy_tpu.solver import BaseSolver
+from flashy_tpu.xp import temporary_xp
+
+
+@pytest.fixture()
+def injector():
+    inj = chaos.install()
+    yield inj
+    chaos.uninstall()
+
+
+@pytest.fixture()
+def fast_retry(monkeypatch):
+    """Stub the backoff sleep out (the module is reached via sys.modules:
+    the package attribute `resilience.retry` is the decorator)."""
+    import sys
+    monkeypatch.setattr(sys.modules["flashy_tpu.resilience.retry"],
+                        "_sleep", lambda _: None)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_guard():
+    yield
+    resilience.disable_preemption_guard()
+    chaos.uninstall()
+
+
+# ----------------------------------------------------------------------
+# retry / backoff
+# ----------------------------------------------------------------------
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert call_with_retry(flaky, sleep=sleeps.append) == "ok"
+    assert calls["n"] == 3
+    assert len(sleeps) == 2  # slept before each retry, not after success
+
+
+def test_retry_exhausted_raises_last_error():
+    def broken():
+        raise OSError("forever")
+
+    with pytest.raises(OSError, match="forever"):
+        call_with_retry(broken, attempts=3, sleep=lambda _: None)
+
+
+def test_retry_exhausted_can_degrade_to_warning(caplog):
+    def broken():
+        raise ValueError("backend down")
+
+    with caplog.at_level(logging.WARNING, "flashy_tpu.resilience.retry"):
+        out = call_with_retry(broken, attempts=2, retry_on=(ValueError,),
+                              on_exhausted="warn", sleep=lambda _: None)
+    assert out is None
+    assert any("degrading to a warning" in r.message for r in caplog.records)
+
+
+def test_retry_only_retries_allowlisted_exceptions():
+    calls = {"n": 0}
+
+    def bug():
+        calls["n"] += 1
+        raise KeyError("a bug, not a transient")
+
+    with pytest.raises(KeyError):
+        call_with_retry(bug, retry_on=(OSError,), sleep=lambda _: None)
+    assert calls["n"] == 1  # no retry: not declared transient
+
+
+def test_backoff_exponential_growth_and_cap():
+    delays = [backoff_delay(a, base_delay=0.1, max_delay=0.5, jitter=0.0)
+              for a in range(1, 6)]
+    assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]
+    jittered = backoff_delay(1, base_delay=0.1, max_delay=1.0, jitter=0.5)
+    assert 0.1 <= jittered <= 0.15
+
+
+def test_retry_attempts_journaled_through_tracer(tmp_path):
+    from flashy_tpu import observability
+    telemetry = observability.enable_telemetry(folder=tmp_path,
+                                               with_device_stats=False)
+    try:
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise OSError("transient")
+
+        call_with_retry(flaky, name="test.site", sleep=lambda _: None)
+        telemetry.close()
+        records = [json.loads(line)
+                   for line in (tmp_path / "telemetry.jsonl").open()]
+        retries = [r for r in records if r.get("type") == "retry"]
+        assert len(retries) == 1
+        assert retries[0]["site"] == "test.site"
+        assert retries[0]["outcome"] == "retrying"
+    finally:
+        observability.disable_telemetry()
+
+
+# ----------------------------------------------------------------------
+# fault injector
+# ----------------------------------------------------------------------
+def test_fault_injector_fires_nth_occurrence(injector):
+    injector.fail_at("site.a", call=2)
+    chaos.fault_point("site.a")  # occurrence 1: armed for 2, no fire
+    with pytest.raises(chaos.InjectedFault):
+        chaos.fault_point("site.a")
+    chaos.fault_point("site.a")  # occurrence 3: rule spent
+    assert injector.counts["site.a"] == 3
+    assert injector.hits("site.a") == 1
+
+
+def test_fault_injector_noop_when_uninstalled():
+    chaos.uninstall()
+    chaos.fault_point("anything")  # must not raise
+
+
+def test_corrupt_file_roundtrip(tmp_path):
+    target = tmp_path / "blob.bin"
+    target.write_bytes(b"hello world")
+    chaos.corrupt_file(target, offset=1, nbytes=4)
+    assert target.read_bytes() != b"hello world"
+    assert len(target.read_bytes()) == len(b"hello world")
+
+
+# ----------------------------------------------------------------------
+# integrity manifests
+# ----------------------------------------------------------------------
+def test_manifest_verify_ok_then_detects_corruption(tmp_path):
+    slot = tmp_path / "slot0"
+    (slot / "arrays").mkdir(parents=True)
+    (slot / "state.pkl").write_bytes(pickle.dumps({"w": 1}))
+    (slot / "arrays" / "shard0").write_bytes(b"\x01\x02\x03")
+    resilience.write_manifest(slot)
+    assert resilience.verify_slot(slot) == []
+
+    chaos.corrupt_file(slot / "arrays" / "shard0")
+    problems = resilience.verify_slot(slot)
+    assert problems and "sha256 mismatch" in problems[0]
+
+
+def test_manifest_detects_missing_file(tmp_path):
+    slot = tmp_path / "slot0"
+    slot.mkdir()
+    (slot / "state.pkl").write_bytes(b"x" * 16)
+    resilience.write_manifest(slot)
+    (slot / "state.pkl").unlink()
+    problems = resilience.verify_slot(slot)
+    assert problems and "missing" in problems[0]
+
+
+def test_missing_manifest_is_legacy_ok_unless_strict(tmp_path):
+    slot = tmp_path / "slot0"
+    slot.mkdir()
+    (slot / "state.pkl").write_bytes(b"x")
+    assert resilience.verify_slot(slot) == []
+    assert resilience.verify_slot(slot, strict=True)
+
+
+# ----------------------------------------------------------------------
+# checkpoint wrapping + fallback
+# ----------------------------------------------------------------------
+def test_load_state_wraps_unpickling_error(tmp_path):
+    bad = tmp_path / "checkpoint.fsy"
+    bad.write_bytes(b"this is not a pickle")
+    with pytest.raises(resilience.CheckpointError, match=str(bad)):
+        ckpt.load_state(bad)
+
+
+def test_load_state_verifies_sidecar(tmp_path):
+    path = tmp_path / "checkpoint.fsy"
+    ckpt.save_state({"w": np.arange(3)}, path)
+    assert resilience.verify_file(path, strict=True) == []
+    chaos.corrupt_file(path, offset=2)
+    with pytest.raises(resilience.CheckpointCorrupted):
+        ckpt.load_state(path)
+
+
+def test_sharded_fallback_to_sibling_slot(tmp_path, caplog):
+    directory = tmp_path / "ckpt.sharded"
+    ckpt.save_state_sharded({"w": np.full(4, 1.0)}, directory)   # slot0
+    ckpt.save_state_sharded({"w": np.full(4, 2.0)}, directory)   # slot1 active
+    slot = chaos.corrupt_active_slot(directory)
+    assert slot == "slot1"
+    with caplog.at_level(logging.WARNING, "flashy_tpu.checkpoint"):
+        state = ckpt.load_state_sharded(directory)
+    np.testing.assert_array_equal(state["w"], np.full(4, 1.0))  # older epoch
+    assert any("FALLBACK" in r.message for r in caplog.records)
+
+
+def test_fallback_repoints_current_so_next_save_spares_good_slot(tmp_path):
+    directory = tmp_path / "ckpt.sharded"
+    ckpt.save_state_sharded({"w": 1}, directory)   # slot0
+    ckpt.save_state_sharded({"w": 2}, directory)   # slot1 active
+    chaos.corrupt_active_slot(directory)
+    assert ckpt.load_state_sharded(directory)["w"] == 1
+    # the pointer now names the slot that actually restored, so the
+    # next save overwrites the CORRUPT slot, not the only good copy
+    assert ckpt._read_slot_pointer(directory) == "slot0"
+    ckpt.save_state_sharded({"w": 3}, directory)   # lands in slot1
+    assert ckpt._read_slot_pointer(directory) == "slot1"
+    assert ckpt.load_state_sharded(directory)["w"] == 3
+    # and the pre-fallback state is still intact in slot0
+    assert ckpt._load_slot_skeleton(directory, "slot0")["w"] == 1
+
+
+def test_sharded_both_slots_corrupt_raises(tmp_path):
+    directory = tmp_path / "ckpt.sharded"
+    ckpt.save_state_sharded({"w": 1}, directory)
+    ckpt.save_state_sharded({"w": 2}, directory)
+    for slot in ("slot0", "slot1"):
+        chaos.corrupt_file(directory / slot / "state.pkl", offset=1)
+    with pytest.raises(resilience.CheckpointCorrupted, match="both A/B"):
+        ckpt.load_state_sharded(directory)
+
+
+def test_sharded_fallback_when_active_payload_missing(tmp_path):
+    directory = tmp_path / "ckpt.sharded"
+    ckpt.save_state_sharded({"w": 1}, directory)
+    ckpt.save_state_sharded({"w": 2}, directory)
+    (directory / "slot1" / "state.pkl").unlink()
+    assert ckpt.sharded_checkpoint_exists(directory)
+    assert ckpt.load_state_sharded(directory)["w"] == 1
+
+
+def test_slots_gain_manifest_on_commit(tmp_path):
+    directory = tmp_path / "ckpt.sharded"
+    ckpt.save_state_sharded({"w": 3}, directory)
+    active = ckpt._read_slot_pointer(directory)
+    assert (directory / active / resilience.MANIFEST_NAME).exists()
+    report = resilience.verify_checkpoint(tmp_path, checkpoint_name="ckpt")
+    assert report["restorable"] and report["slots"][active] == []
+
+
+def test_transient_ckpt_write_fault_is_retried(tmp_path, injector,
+                                               fast_retry):
+    injector.fail_at("ckpt.write", call=1)
+    ckpt.save_state({"w": 7}, tmp_path / "c.fsy")
+    assert ckpt.load_state(tmp_path / "c.fsy") == {"w": 7}
+    assert injector.hits("ckpt.write") == 1
+
+
+# ----------------------------------------------------------------------
+# solver integration: rollback, preemption, resume exactness
+# ----------------------------------------------------------------------
+class _Toy(BaseSolver):
+    """Deterministic numpy solver (metrics are pure functions of state)."""
+
+    def __init__(self, epochs=4, steps=3):
+        super().__init__()
+        self.epochs = epochs
+        self.steps = steps
+        self.w = np.zeros(2)
+        self.register_stateful("w")
+
+    def train_stage(self):
+        for step in range(self.steps):
+            chaos.fault_point("toy.step", step=step)
+            self.check_preemption()
+            self.w = self.w + self.epoch
+        return {"loss": float(self.w.sum())}
+
+    def run(self):
+        self.restore()
+        for _ in range(self.epoch, self.epochs + 1):
+            self.run_stage("train", self.train_stage)
+            self.commit()
+
+
+def test_commit_rolls_back_history_on_failed_save(injector, fast_retry):
+    with temporary_xp():
+        solver = _Toy()
+        solver.run_stage("train", solver.train_stage)
+        pending = dict(solver._pending_metrics)
+        # exactly the retry budget: every attempt of the first commit
+        # fails; the follow-up commit runs clean
+        injector.fail_at("ckpt.write", call=1, times=4)
+        with pytest.raises(OSError):
+            solver.commit()
+        # epoch never ran ahead of what is restorable:
+        assert solver.epoch == 1
+        assert solver.history == []
+        assert solver._pending_metrics == pending
+        assert not (solver.folder / "history.json").exists()
+        # the next (unfaulted) commit lands the same epoch cleanly
+        solver.commit()
+        assert solver.epoch == 2
+        assert len(solver.history) == 1
+        assert solver.checkpoint_path.exists()
+
+
+def test_async_commit_failure_rolls_back_covered_epochs(injector, fast_retry):
+    # An async save's write failure surfaces one commit LATE (at the
+    # next finalize); the rollback must drop the epochs THAT save
+    # covered, not the epoch being committed now.
+    with temporary_xp():
+        solver = _Toy()
+        solver.checkpoint_mode = "sharded"
+        solver.checkpoint_async = True
+        solver.run_stage("train", solver.train_stage)
+        solver.commit()  # epoch 1: async save started, not yet durable
+        assert len(solver.history) == 1
+        solver.run_stage("train", solver.train_stage)
+        injector.fail_at("ckpt.write", call=1, times=4)  # = retry budget
+        with pytest.raises(OSError):
+            solver.commit()  # finalize of epoch 1's save fails here
+        # epoch 1 never became durable: memory AND history.json roll back
+        assert solver.history == []
+        assert solver.epoch == 1
+        assert json.loads(
+            (solver.folder / "history.json").read_text()) == []
+        # the epoch-2 metrics stay pending; a clean retry commits both
+        solver.commit()
+        solver.finalize_checkpoints()
+        assert len(solver.history) == 1
+        from flashy_tpu.checkpoint import sharded_checkpoint_exists
+        assert sharded_checkpoint_exists(solver.sharded_checkpoint_path)
+
+
+def test_history_write_transient_fault_retried(injector, fast_retry):
+    with temporary_xp():
+        solver = _Toy()
+        injector.fail_at("history.write", call=1)
+        solver.run_stage("train", solver.train_stage)
+        solver.commit()
+        assert (solver.folder / "history.json").exists()
+        assert injector.hits("history.write") == 1
+
+
+def test_preemption_simulated_signal_stops_at_boundary(injector):
+    with temporary_xp():
+        solver = _Toy(epochs=4)
+        guard = solver.enable_preemption_guard(install=False)
+        assert guard is resilience.get_preemption_guard()
+        # mid-train-stage of epoch 2 (steps are 3 per stage)
+        injector.preempt_at("toy.step", call=4)
+        with pytest.raises(SystemExit) as exit_info:
+            solver.run()
+        assert exit_info.value.code == resilience.EXIT_PREEMPTED
+        # finish_stage mode: epoch 2's stage finished, commit landed,
+        # and the commit boundary took the exit — nothing partial.
+        assert len(solver.history) == 2
+        assert (solver.folder / "preempted.json").exists()
+        marker = json.loads((solver.folder / "preempted.json").read_text())
+        assert marker["committed_epochs"] == 2
+
+
+def test_preemption_resume_is_exact():
+    with temporary_xp() as xp:
+        # uninterrupted oracle run, in a scratch folder, no faults armed
+        with temporary_xp():
+            oracle = _Toy(epochs=4)
+            oracle.run()
+            clean_history = [{s: {k: v for k, v in m.items()
+                                  if k != "duration"}
+                              for s, m in e.items()} for e in oracle.history]
+            clean_w = oracle.w.copy()
+
+        injector = chaos.install()
+        solver = _Toy(epochs=4)
+        solver.enable_preemption_guard(install=False)
+        injector.preempt_at("toy.step", call=5)  # mid epoch 2
+        with pytest.raises(SystemExit):
+            solver.run()
+        chaos.uninstall()
+        resilience.disable_preemption_guard()
+
+        xp.link.load()
+        resumed = _Toy(epochs=4)
+        resumed.run()
+        got = [{s: {k: v for k, v in m.items() if k != "duration"}
+                for s, m in e.items()} for e in resumed.history]
+        assert got == clean_history
+        np.testing.assert_array_equal(resumed.w, clean_w)
+
+
+def test_preemption_abandon_stage_mode(injector):
+    with temporary_xp():
+        solver = _Toy(epochs=4)
+        solver.enable_preemption_guard(mode="abandon_stage", install=False)
+        injector.preempt_at("toy.step", call=4)  # step 1 of epoch 2
+        with pytest.raises(SystemExit) as exit_info:
+            solver.run()
+        assert exit_info.value.code == resilience.EXIT_PREEMPTED
+        # the abandoned stage's epoch never committed
+        assert len(solver.history) == 1
+        assert solver._pending_metrics == {}
+
+
+def test_preemption_guard_real_signal_sets_flag():
+    guard = resilience.enable_preemption_guard()
+    try:
+        assert not guard.requested
+        signal.raise_signal(signal.SIGTERM)
+        assert guard.requested
+        assert guard.signal_name == "SIGTERM"
+        assert guard.should_stop()
+    finally:
+        resilience.disable_preemption_guard()
+
+
+def test_solver_rejects_unknown_preemption_mode():
+    with temporary_xp():
+        solver = _Toy()
+        with pytest.raises(ValueError, match="mode"):
+            solver.enable_preemption_guard(mode="nope", install=False)
+
+
+# ----------------------------------------------------------------------
+# logging backends degrade to warnings
+# ----------------------------------------------------------------------
+class _BrokenBackend:
+    """A backend whose every method raises (a wandb outage stand-in)."""
+
+    def __getattr__(self, name):
+        def method(*args, **kwargs):
+            raise ConnectionError("backend is down")
+
+        return method
+
+
+def test_backend_failure_degrades_to_warning(caplog, fast_retry):
+    with temporary_xp():
+        solver = _Toy()
+        solver.result_logger._experiment_loggers["wandb"] = _BrokenBackend()
+        with caplog.at_level(logging.WARNING,
+                             "flashy_tpu.resilience.retry"):
+            solver.run_stage("train", solver.train_stage)
+            solver.commit()  # training survives the dead backend
+        assert len(solver.history) == 1
+        assert any("logger.wandb" in r.message and "degrading" in r.message
+                   for r in caplog.records)
+
+
+def test_backend_transient_fault_retried_then_succeeds(injector, fast_retry):
+    with temporary_xp():
+        solver = _Toy()
+        injector.fail_at("logger.local", call=1,
+                         exc=lambda: ConnectionError("hiccup"))
+        solver.run_stage("train", solver.train_stage)
+        assert injector.hits("logger.local") == 1
+        # the retried call reached the backend: metrics were journaled
+        import csv
+        metrics_file = solver.folder / "train" / "metrics.csv"
+        if metrics_file.exists():
+            rows = list(csv.reader(metrics_file.open()))
+            assert rows
+
+
+# ----------------------------------------------------------------------
+# hang watchdog
+# ----------------------------------------------------------------------
+def test_hang_watchdog_warns_on_stalled_rank(tmp_path):
+    from flashy_tpu.observability import Heartbeat
+    Heartbeat(tmp_path, rank=0, world_size=2, with_device_stats=False).beat(
+        step=5, force=True)
+    Heartbeat(tmp_path, rank=1, world_size=2, with_device_stats=False).beat(
+        step=5, force=True)
+    chaos.stall_heartbeat(tmp_path, rank=1, age=300.0)
+
+    warnings = []
+    watchdog = resilience.HangWatchdog(tmp_path, warn_after=120.0,
+                                       on_warn=warnings.append)
+    report = watchdog.check()
+    assert report["stalled"] == [1]
+    assert report["action"] == "warn"
+    assert warnings and "rank(s) [1]" in warnings[0]
+    # second check: same episode, no duplicate warning
+    assert watchdog.check()["action"] is None
+    assert len(warnings) == 1
+
+
+def test_hang_watchdog_aborts_past_threshold(tmp_path):
+    from flashy_tpu.observability import Heartbeat
+    Heartbeat(tmp_path, rank=0, world_size=1, with_device_stats=False).beat(
+        force=True)
+    chaos.stall_heartbeat(tmp_path, rank=0, age=1000.0)
+
+    aborted = []
+    watchdog = resilience.HangWatchdog(
+        tmp_path, warn_after=60.0, abort_after=600.0,
+        on_warn=lambda _: None,
+        on_abort=lambda code, report: aborted.append((code, report)))
+    report = watchdog.check()
+    assert report["action"] == "abort"
+    assert aborted and aborted[0][0] == resilience.EXIT_HUNG
+
+
+def test_hang_watchdog_quiet_when_all_fresh(tmp_path):
+    from flashy_tpu.observability import Heartbeat
+    Heartbeat(tmp_path, rank=0, world_size=1, with_device_stats=False).beat(
+        force=True)
+    watchdog = resilience.HangWatchdog(tmp_path, warn_after=120.0)
+    report = watchdog.check()
+    assert report["stalled"] == [] and report["action"] is None
+
+
+def test_hang_watchdog_rejects_bad_thresholds(tmp_path):
+    with pytest.raises(ValueError):
+        resilience.HangWatchdog(tmp_path, warn_after=100.0, abort_after=50.0)
+
+
+# ----------------------------------------------------------------------
+# info CLI + chaos drill
+# ----------------------------------------------------------------------
+def test_info_verify_checkpoint_cli(tmp_path, capsys):
+    from flashy_tpu.info import main as info_main
+    from flashy_tpu.xp import Config, create_xp
+
+    xp = create_xp(Config({"a": 1}), root=tmp_path)
+    with xp.enter():
+        solver = _Toy()
+        solver.checkpoint_mode = "sharded"
+        solver.run_stage("train", solver.train_stage)
+        solver.commit()
+        solver.run_stage("train", solver.train_stage)
+        solver.commit()
+    assert info_main([str(tmp_path), "--verify-checkpoint"]) == 0
+    assert "restorable" in capsys.readouterr().out
+
+    # active slot corrupt, sibling intact: still restorable (exit 0)
+    chaos.corrupt_active_slot(solver.sharded_checkpoint_path)
+    assert info_main([str(tmp_path), "--verify-checkpoint"]) == 0
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and "restorable" in out
+
+    # both gone: operator must act (exit 1)
+    chaos.corrupt_file(
+        solver.sharded_checkpoint_path / "slot0" / "state.pkl", offset=1)
+    assert info_main([str(tmp_path), "--verify-checkpoint"]) == 1
+    assert "NOT RESTORABLE" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_chaos_drill_end_to_end(tmp_path):
+    from flashy_tpu.resilience.__main__ import run_drill
+    assert run_drill(epochs=5, root=str(tmp_path)) == 0

@@ -204,6 +204,50 @@ def test_engine_requires_rng_for_sampling():
         DecodeEngine(model, params, slots=2, temperature=0.7)
 
 
+def test_scheduler_rejects_prompt_beyond_largest_bucket():
+    # the failure must happen at submit() — not mid-decode after the
+    # request occupied a slot behind everyone else.
+    model, params = _tiny_model(max_seq_len=32)
+    engine = DecodeEngine(model, params, slots=2)
+    scheduler = ContinuousBatchingScheduler(engine)
+    too_long = np.zeros(33, np.int32)
+    with pytest.raises(ValueError, match="largest prefill bucket"):
+        scheduler.submit(too_long, max_new_tokens=1)
+    assert scheduler.queue_depth == 0
+
+
+def test_scheduler_rejects_nonpositive_ttl():
+    model, params = _tiny_model()
+    engine = DecodeEngine(model, params, slots=2)
+    scheduler = ContinuousBatchingScheduler(engine)
+    with pytest.raises(ValueError, match="ttl"):
+        scheduler.submit(np.zeros(4, np.int32), max_new_tokens=2, ttl=0.0)
+
+
+def test_scheduler_ttl_sheds_expired_before_prefill():
+    model, params = _tiny_model()
+    engine = DecodeEngine(model, params, slots=2)
+    engine.warmup(prompt_lengths=[4])
+    scheduler = ContinuousBatchingScheduler(engine)
+    prompt = np.arange(4, dtype=np.int32) % 32
+
+    expired = scheduler.submit(prompt, max_new_tokens=2, ttl=1e-9)
+    fresh = scheduler.submit(prompt, max_new_tokens=2, ttl=60.0)
+    import time
+    time.sleep(0.01)  # let the tiny TTL lapse while still queued
+    scheduler.run()
+
+    assert expired.done and expired.finish_reason == "expired"
+    assert expired.generated == []          # never prefilled
+    assert expired.slot is None             # never occupied a slot
+    assert fresh.done and fresh.finish_reason in ("eos", "length")
+    assert len(fresh.generated) == 2
+    summary = scheduler.metrics.summary()
+    assert summary["expired"] == 1
+    assert summary["finish_expired"] == 1
+    assert summary["completed"] == 1
+
+
 @pytest.mark.slow
 def test_serve_matches_generate_end_to_end():
     # N requests in -> N greedy completions out, token-exact against
