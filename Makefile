@@ -39,10 +39,17 @@ serve-demo:
 chaos-demo:
 	JAX_PLATFORMS=cpu python -m flashy_tpu.resilience --epochs 5
 
+# ZeRO-1 sharded-weight-update demo on 8 virtual CPU devices: replicated
+# vs zero1 vs fsdp step time + per-chip optimizer HBM, exit 1 on any
+# numeric drift from the replicated path or any post-warm-up recompile.
+zero-demo:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m flashy_tpu.parallel.zero --steps 3
+
 docs:
 	python tools/gendocs.py -o docs/api -p flashy_tpu \
 		-c 'flashy_tpu.observability*' -c 'flashy_tpu.serve*' \
-		-c 'flashy_tpu.resilience*'
+		-c 'flashy_tpu.resilience*' -c 'flashy_tpu.parallel*'
 
 native:
 	python tools/build_native.py
@@ -50,4 +57,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all coverage bench serve-demo chaos-demo docs native dist
+.PHONY: default linter tests tests-all coverage bench serve-demo chaos-demo zero-demo docs native dist

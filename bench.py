@@ -708,6 +708,57 @@ def bench_decode(jax, on_tpu: bool):
             "ms_per_generate": round(elapsed * 1e3, 1)}
 
 
+def bench_zero(jax, on_tpu: bool):
+    """ZeRO-1 sharded weight update vs replicated vs FSDP on the LM:
+    step time + per-chip optimizer-state HBM bytes per layout, plus the
+    watchdog's post-warm-up recompile count for the 3-step run (must be
+    0 — see flashy_tpu/parallel/zero.py).
+
+    On the chip the measurement runs inline over the attached devices.
+    On CPU fallback it runs in a SUBPROCESS with 8 virtual devices
+    (sharding over this host's single CPU device would be vacuous, and
+    the flag must be set before backend init — too late in-process).
+    """
+    if on_tpu:
+        from flashy_tpu.parallel.zero import run_zero_bench
+        result = run_zero_bench(steps=3)
+    else:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8")
+        cmd = [sys.executable, "-m", "flashy_tpu.parallel.zero",
+               "--steps", "3"]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=900, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            return {"error": "zero leg subprocess timed out"}
+        lines = (proc.stdout or "").strip().splitlines()
+        try:
+            result = json.loads(lines[-1])
+        except (IndexError, ValueError):
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            return {"error": f"zero leg rc={proc.returncode}: "
+                             + " | ".join(tail)}
+        result["virtual_devices"] = True
+        if proc.returncode != 0:
+            result["error"] = "zero demo reported a violation (see stderr)"
+    # compact-payload scalars (the nested dicts stay in BENCH_DETAIL)
+    for mode in ("replicated", "zero1", "fsdp"):
+        if mode in result.get("step_ms", {}):
+            result[f"step_ms_{mode}"] = result["step_ms"][mode]
+        if mode in result.get("opt_state_bytes_per_chip", {}):
+            result[f"opt_state_bytes_per_chip_{mode}"] = \
+                result["opt_state_bytes_per_chip"][mode]
+    log(f"zero: opt bytes/chip zero1/replicated="
+        f"{result.get('opt_bytes_ratio_zero1')} over "
+        f"{result.get('n_devices')} devices; step_ms={result.get('step_ms')}; "
+        f"recompiles={result.get('recompiles')}")
+    return result
+
+
 def bench_ring(jax, on_tpu: bool):
     """Ring attention (shard_map + pallas per-block kernel) vs the plain
     flash kernel at the same global shape. With one attached chip the
@@ -927,6 +978,8 @@ _COMPACT_KEYS = {
     "lm": ("tokens_per_sec_per_chip", "mfu", "mfu_vs_measured",
            "achieved_tflops_per_chip", "variant"),
     "attention": ("speedup", "flash_tuned_ms"),
+    "zero": ("opt_bytes_ratio_zero1", "step_ms_zero1", "step_ms_replicated",
+             "recompiles"),
     "ring": ("overhead_pct",),
     "gan": ("steps_per_sec",),
     "decode": ("tokens_per_sec_per_chip",),
@@ -1017,8 +1070,8 @@ def _persist_partial(extra: dict) -> None:
 # and for the supervision tests.
 _LEGS_FILTER = os.environ.get("FLASHY_TPU_BENCH_LEGS")
 LEG_ORDER = tuple(
-    name for name in ("smoke", "mxu", "cifar", "lm", "attention", "ring",
-                      "gan", "decode", "host_sync", "all_reduce")
+    name for name in ("smoke", "mxu", "cifar", "lm", "attention", "zero",
+                      "ring", "gan", "decode", "host_sync", "all_reduce")
     if _LEGS_FILTER is None or name in _LEGS_FILTER.split(","))
 
 
@@ -1073,6 +1126,7 @@ def child_main() -> None:
         "cifar": lambda: bench_cifar(jax, on_tpu),
         "lm": lambda: bench_lm(jax, on_tpu, peak, measured_flops()),
         "attention": lambda: bench_flash_attention(jax, on_tpu),
+        "zero": lambda: bench_zero(jax, on_tpu),
         "ring": lambda: bench_ring(jax, on_tpu),
         "decode": lambda: bench_decode(jax, on_tpu),
         "gan": lambda: bench_gan(jax, on_tpu),

@@ -426,12 +426,18 @@ def load_state_sharded(directory: AnyPath, placements: tp.Any = None) -> tp.Any:
         restore_args: tp.Dict[str, tp.Any] = {}
         for key in slot_keys:
             target = placement_by_key.get(key)
-            if isinstance(target, jax.Array):
-                item[key] = jax.ShapeDtypeStruct(target.shape, target.dtype,
-                                                 sharding=target.sharding)
+            # jax.Array, or an abstract jax.ShapeDtypeStruct carrying a
+            # sharding (how BaseSolver.set_state_sharding declares ZeRO/
+            # FSDP placements without materializing a template array) —
+            # either way each host reads only its own shards.
+            target_sharding = getattr(target, "sharding", None)
+            if target_sharding is not None and hasattr(target, "shape"):
+                item[key] = jax.ShapeDtypeStruct(tuple(target.shape),
+                                                 target.dtype,
+                                                 sharding=target_sharding)
                 restore_args[key] = ocp.ArrayRestoreArgs(
-                    sharding=target.sharding, global_shape=target.shape,
-                    dtype=target.dtype)
+                    sharding=target_sharding,
+                    global_shape=tuple(target.shape), dtype=target.dtype)
             else:
                 item[key] = 0
                 restore_args[key] = ocp.RestoreArgs()
@@ -465,7 +471,9 @@ def place_like(template: tp.Any, restored: tp.Any) -> tp.Any:
     """
     if template is None:
         return restored
-    if isinstance(template, jax.Array):
+    if isinstance(template, jax.Array) or (
+            isinstance(template, jax.ShapeDtypeStruct)
+            and template.sharding is not None):
         if (hasattr(restored, "shape")
                 and tuple(restored.shape) == tuple(template.shape)):
             return jax.device_put(restored, template.sharding)

@@ -8,7 +8,9 @@ from pathlib import Path
 
 
 def collect(root: Path):
-    """Yield {sig, cfg, argv, history, telemetry, serve} per XP under root."""
+    """Yield {sig, cfg, argv, history, telemetry, serve, checkpoint} per
+    XP under root."""
+    from .solver import CHECKPOINT_META_NAME
     from .xp import (CONFIG_SNAPSHOT_NAME, HEARTBEAT_DIR_NAME, RUN_INFO_NAME,
                      SERVE_STATUS_NAME, Link)
     from .observability import straggler_report
@@ -20,7 +22,11 @@ def collect(root: Path):
         if not folder.is_dir():
             continue
         entry = {"sig": folder.name, "cfg": {}, "argv": [], "history": [],
-                 "telemetry": {}, "serve": {}}
+                 "telemetry": {}, "serve": {}, "checkpoint": {}}
+        meta_path = folder / CHECKPOINT_META_NAME
+        if meta_path.exists():
+            with open(meta_path) as f:
+                entry["checkpoint"] = json.load(f)
         config_path = folder / CONFIG_SNAPSHOT_NAME
         if config_path.exists():
             with open(config_path) as f:
@@ -61,6 +67,8 @@ def format_entry(entry, verbose: bool = False) -> str:
         line += "\n  heartbeats: " + format_straggler_report(entry["telemetry"])
     if entry.get("serve"):
         line += "\n  serve: " + format_serve_status(entry["serve"])
+    if entry.get("checkpoint"):
+        line += "\n  checkpoint: " + format_checkpoint_meta(entry["checkpoint"])
     if verbose:
         line += "\n  cfg: " + json.dumps(entry["cfg"], default=str)[:500]
     return line
@@ -83,6 +91,21 @@ def format_serve_status(status: dict) -> str:
     if "occupancy_p50" in status:
         parts.append(f"occupancy_p50={status['occupancy_p50'] * 100:.0f}%")
     return "  ".join(parts) or "(empty serve.json)"
+
+
+def format_checkpoint_meta(meta: dict) -> str:
+    """One-line view of a `checkpoint_meta.json` snapshot: the save mode
+    and the active state-sharding layout the solver will restore with
+    (`replicated` / `zero1(data=N)` / `fsdp(...)` — see
+    `parallel.zero.describe_state_sharding`)."""
+    parts = []
+    if meta.get("mode"):
+        parts.append(f"mode={meta['mode']}")
+    sharding = meta.get("state_sharding") or {}
+    summary = sharding.get("summary") or sharding.get("mode")
+    if summary:
+        parts.append(f"state-sharding={summary}")
+    return "  ".join(parts) or "(empty checkpoint_meta.json)"
 
 
 def format_verify_report(sig: str, report: dict) -> str:

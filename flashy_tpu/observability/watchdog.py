@@ -73,8 +73,7 @@ class RecompileWatchdog:
                 f"jitted callable, not the python one.")
         fn_name = name or getattr(fn, "__name__", None) or repr(fn)
         allowed = self.warmup if warmup is None else warmup
-        entry = self.counts.setdefault(fn_name, {"calls": 0, "compiles": 0,
-                                                 "recompiles": 0})
+        entry = self._entry(fn_name)
 
         @functools.wraps(fn)
         def wrapped(*args: tp.Any, **kwargs: tp.Any) -> tp.Any:
@@ -83,24 +82,49 @@ class RecompileWatchdog:
             grew = cache_size() - before
             entry["calls"] += 1
             if grew > 0:
-                entry["compiles"] += grew
-                if entry["compiles"] > allowed:
-                    entry["recompiles"] += grew
-                    shapes = describe_abstract(args, kwargs)
-                    self._logger.warning(
-                        "recompile #%d of %r (after %d warm-up compiles) "
-                        "triggered by arguments: %s",
-                        entry["compiles"], fn_name, allowed, shapes)
-                    if self.tracer is not None:
-                        self.tracer.instant(f"recompile/{fn_name}",
-                                            category="watchdog", shapes=shapes)
-                        self.tracer.record({"type": "recompile", "fn": fn_name,
-                                            "compiles": entry["compiles"],
-                                            "shapes": shapes})
+                shapes = describe_abstract(args, kwargs)
+                for _ in range(grew):
+                    self.note_compile(fn_name, shapes, warmup=allowed)
             return out
 
         wrapped.watchdog_name = fn_name  # type: ignore[attr-defined]
         return wrapped
+
+    def note_call(self, name: str) -> None:
+        """Tally one call of an externally-managed compile cache under
+        `name` (see `note_compile`)."""
+        self._entry(name)["calls"] += 1
+
+    def note_compile(self, name: str, description: str = "", *,
+                     warmup: tp.Optional[int] = None) -> int:
+        """Record one compile under `name`; past `warmup`, WARN with
+        `description` (the offending shapes), fire the tracer events and
+        tally a recompile. The shared core of `watch`, exposed directly
+        for compile caches the watchdog cannot wrap (e.g.
+        `parallel.wrap`'s per-state-shape executable cache, where every
+        entry is a distinct jit function). Returns the total recompiles
+        recorded under `name`.
+        """
+        entry = self._entry(name)
+        allowed = self.warmup if warmup is None else warmup
+        entry["compiles"] += 1
+        if entry["compiles"] > allowed:
+            entry["recompiles"] += 1
+            self._logger.warning(
+                "recompile #%d of %r (after %d warm-up compiles) "
+                "triggered by arguments: %s",
+                entry["compiles"], name, allowed, description)
+            if self.tracer is not None:
+                self.tracer.instant(f"recompile/{name}",
+                                    category="watchdog", shapes=description)
+                self.tracer.record({"type": "recompile", "fn": name,
+                                    "compiles": entry["compiles"],
+                                    "shapes": description})
+        return entry["recompiles"]
+
+    def _entry(self, name: str) -> tp.Dict[str, int]:
+        return self.counts.setdefault(name, {"calls": 0, "compiles": 0,
+                                             "recompiles": 0})
 
     def summary(self) -> tp.Dict[str, int]:
         """Total recompiles-past-warmup per watched function (nonzero only)."""

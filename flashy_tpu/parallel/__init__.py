@@ -11,4 +11,24 @@ from .ring import ring_attention, ring_self_attention
 from .ring_fused import fused_ring_attention
 from .pipeline import pipeline
 from .moe_ep import ep_dropless_moe
-from .accounting import collective_stats, memory_stats, total_collective_bytes
+from .accounting import (collective_stats, compare_collective_stats,
+                         memory_stats, total_collective_bytes)
+
+# ZeRO-1/2 exports resolve lazily (PEP 562): `python -m
+# flashy_tpu.parallel.zero` is a CLI entry point, and an eager
+# `from .zero import ...` here would put the module in sys.modules
+# before runpy executes it — a double-execution RuntimeWarning on every
+# zero-demo / bench run.
+_ZERO_EXPORTS = ("zero_sharding", "zero_update", "per_device_bytes",
+                 "describe_state_sharding")
+
+
+def __getattr__(name):
+    if name in _ZERO_EXPORTS:
+        from . import zero
+        return getattr(zero, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_ZERO_EXPORTS))

@@ -84,8 +84,13 @@ _CONTEXT_RE = re.compile(r"^[su]32\[\]")
 # `-start` ops whose output tuple PREPENDS the input-shaped operand
 # alias(es) to the result(s). all-reduce-start is deliberately absent:
 # its (possibly variadic tuple) output holds results only, so the full
-# tuple is already the sync-equivalent byte count.
-_OPERAND_ALIASING_STARTS = {"all-gather", "collective-permute"}
+# tuple is already the sync-equivalent byte count. reduce-scatter-start
+# matters for the ZeRO-1 path (parallel.zero): its operand alias is the
+# UNREDUCED full gradient, axis_size x the result shard — counting the
+# whole tuple would overstate the sharded update's traffic by exactly
+# the factor the optimization exists to remove.
+_OPERAND_ALIASING_STARTS = {"all-gather", "collective-permute",
+                            "reduce-scatter"}
 
 
 def _async_start_bytes(op: str, shape_text: str) -> tp.Optional[int]:
@@ -176,6 +181,26 @@ def collective_stats(compiled: tp.Any) -> tp.Dict[str, tp.Dict[str, int]]:
 def total_collective_bytes(compiled: tp.Any) -> int:
     """Sum of `collective_stats` bytes over every collective kind."""
     return sum(e["bytes"] for e in collective_stats(compiled).values())
+
+
+def compare_collective_stats(compiled: tp.Any,
+                             baseline: tp.Any) -> tp.Dict[str, tp.Dict[str, int]]:
+    """Per-collective (count, bytes) DELTA of `compiled` minus `baseline`.
+
+    The comms story of a sharding change in one dict: compiling the same
+    step replicated and ZeRO-1-sharded and diffing them shows the
+    all-reduce bytes that became reduce-scatter + all-gather (and would
+    show a silent regression to replication as the delta collapsing to
+    zero). Ops with a zero delta in both fields are omitted.
+    """
+    ours, theirs = collective_stats(compiled), collective_stats(baseline)
+    delta = {}
+    for op in COLLECTIVE_OPS:
+        entry = {field: ours[op][field] - theirs[op][field]
+                 for field in ("count", "bytes")}
+        if entry["count"] or entry["bytes"]:
+            delta[op] = entry
+    return delta
 
 
 def memory_stats(compiled: tp.Any) -> tp.Dict[str, int]:

@@ -8,6 +8,8 @@
 # of `eager_sync_gradients` (flashy/distrib.py:153-190), done by the
 # compiler instead of by hooks.
 """Data-parallel / FSDP step wrapping and batch sharding helpers."""
+import collections
+import itertools
 import logging
 import typing as tp
 
@@ -16,11 +18,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability.watchdog import RecompileWatchdog, describe_abstract
 from .mesh import default_mesh
 
 logger = logging.getLogger(__name__)
 
 BATCH_AXES = ("data", "fsdp")
+
+# Compile accounting for `wrap` when telemetry is off: misses still land
+# in a watchdog so `wrapped.compile_stats()` always answers (mirrors the
+# private-watchdog fallback of serve.CompileCache).
+_fallback_watchdog = RecompileWatchdog(warmup=1)
+_wrap_ids = itertools.count()
 
 
 def replicate(tree: tp.Any, mesh: tp.Optional[Mesh] = None) -> tp.Any:
@@ -54,17 +63,13 @@ def shard_batch(batch: tp.Any, mesh: tp.Optional[Mesh] = None,
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
 
 
-def fsdp_sharding(tree: tp.Any, mesh: tp.Optional[Mesh] = None,
-                  axis: str = "fsdp", min_size: int = 2 ** 16) -> tp.Any:
-    """Per-leaf NamedShardings that split each large parameter over `axis`.
-
-    The largest dimension divisible by the axis size is sharded; small
-    leaves stay replicated (sharding tiny arrays costs more in collective
-    latency than it saves in HBM). With params sharded this way and the
-    batch sharded on ('data','fsdp'), XLA emits the ZeRO-3 pattern:
-    all-gather params into each matmul, reduce-scatter the grads.
-    """
-    mesh = mesh or default_mesh()
+def axis_leaf_sharding(mesh: Mesh, axis: str,
+                       min_size: int) -> tp.Callable[[tp.Any], NamedSharding]:
+    """Leaf rule shared by `fsdp_sharding` (axis='fsdp') and
+    `zero.zero_sharding` (axis='data'): shard the largest dimension
+    divisible by the axis size; leaves below `min_size` elements stay
+    replicated (sharding tiny arrays costs more in collective latency
+    than it saves in HBM)."""
     axis_size = mesh.shape[axis]
 
     def leaf_sharding(x) -> NamedSharding:
@@ -79,7 +84,23 @@ def fsdp_sharding(tree: tp.Any, mesh: tp.Optional[Mesh] = None,
                     return NamedSharding(mesh, P(*spec))
         return NamedSharding(mesh, P())
 
-    return jax.tree_util.tree_map(leaf_sharding, tree)
+    return leaf_sharding
+
+
+def fsdp_sharding(tree: tp.Any, mesh: tp.Optional[Mesh] = None,
+                  axis: str = "fsdp", min_size: int = 2 ** 16) -> tp.Any:
+    """Per-leaf NamedShardings that split each large parameter over `axis`.
+
+    The largest dimension divisible by the axis size is sharded; small
+    leaves stay replicated. With params sharded this way and the
+    batch sharded on ('data','fsdp'), XLA emits the ZeRO-3 pattern:
+    all-gather params into each matmul, reduce-scatter the grads.
+    For the ZeRO-1 middle ground (shard only the *update*, keep compute
+    params replicated) see `flashy_tpu.parallel.zero`.
+    """
+    mesh = mesh or default_mesh()
+    return jax.tree_util.tree_map(axis_leaf_sharding(mesh, axis, min_size),
+                                  tree)
 
 
 def shard_params(params: tp.Any, mesh: tp.Optional[Mesh] = None,
@@ -152,29 +173,51 @@ def with_grad_accumulation(value_and_grad_fn: tp.Callable,
 
         micro = jax.tree_util.tree_map(split, batch)
 
+        # The running sums live in float32 (f64 for f64 grads) no matter
+        # what dtype the grads come back in: a bf16 running sum loses the
+        # low mantissa bits of every addend once the partial sum grows —
+        # past ~8 microbatches the accumulated gradient visibly drifts
+        # from the full-batch one. Output dtypes (from eval_shape, no
+        # FLOPs) are restored after the scan, so the wrapper's contract
+        # — identical signature and results — still holds.
+        loss_struct, grad_struct = jax.eval_shape(
+            value_and_grad_fn, params,
+            jax.tree_util.tree_map(lambda x: x[0], micro),
+            *fold_rng_keys(rest, 0))
+
         def body(carry, inputs):
             index, microbatch = inputs
             loss_acc, grad_acc = carry
             loss, grads = value_and_grad_fn(params, microbatch,
                                             *fold_rng_keys(rest, index))
-            grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
-            return (loss_acc + loss, grad_acc), None
+            grad_acc = jax.tree_util.tree_map(
+                lambda acc, g: acc + g.astype(acc.dtype), grad_acc, grads)
+            return (loss_acc + loss.astype(loss_acc.dtype), grad_acc), None
 
         zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, _grad_dtype(p)), params)
+            lambda g: jnp.zeros(g.shape, _accum_dtype(g.dtype)), grad_struct)
         (loss, grads), _ = jax.lax.scan(
-            body, (jnp.zeros(()), zeros),
+            body, (jnp.zeros(loss_struct.shape,
+                             _accum_dtype(loss_struct.dtype)), zeros),
             (jnp.arange(num_microbatches), micro))
         scale = 1.0 / num_microbatches
-        return loss * scale, jax.tree_util.tree_map(
-            lambda g: g * scale, grads)
+        return ((loss * scale).astype(loss_struct.dtype),
+                jax.tree_util.tree_map(
+                    lambda g, s: (g * scale).astype(s.dtype),
+                    grads, grad_struct))
 
     return wrapped
 
 
-def _grad_dtype(p):
-    dtype = np.dtype(p.dtype)
-    return dtype if np.issubdtype(dtype, np.floating) else np.float32
+def _accum_dtype(dtype):
+    """Accumulator dtype for a gradient/loss dtype: f64/complex stay as
+    they are (already full-width; casting complex to f32 would silently
+    drop the imaginary part), every other float (incl. bf16/f16) is
+    summed in f32."""
+    dtype = np.dtype(dtype)
+    if dtype == np.float64 or np.issubdtype(dtype, np.complexfloating):
+        return dtype
+    return np.float32
 
 
 def wrap(step_fn: tp.Optional[tp.Callable] = None, *,
@@ -183,7 +226,9 @@ def wrap(step_fn: tp.Optional[tp.Callable] = None, *,
          fsdp: bool = False,
          state_sharding: tp.Any = None,
          donate_state: bool = True,
-         static_argnums: tp.Union[int, tp.Sequence[int]] = ()) -> tp.Callable:
+         static_argnums: tp.Union[int, tp.Sequence[int]] = (),
+         watchdog: tp.Optional[RecompileWatchdog] = None,
+         max_cache: int = 8) -> tp.Callable:
     """Make a step function data-parallel over the mesh — the DDP role.
 
     The step must have signature `step(state, batch, *rest) -> (state, aux)`
@@ -198,12 +243,22 @@ def wrap(step_fn: tp.Optional[tp.Callable] = None, *,
     Usable as decorator (`@wrap`) or call (`wrap(step, mesh=mesh)`).
     Feed batches through `shard_batch` (or `flashy_tpu.data` loaders,
     which do it for you).
+
+    The per-state-shape executable cache is bounded (`max_cache`, LRU)
+    and every underlying XLA compile — a state-shape cache miss AND any
+    inner-jit retrace from changed batch/rest shapes — is reported
+    through the PR 1 `RecompileWatchdog` (`watchdog` argument > the
+    enabled telemetry's watchdog > a module fallback), so a step
+    recompiling past warm-up WARNs with the offending argument shapes
+    instead of silently growing a cache; `wrapped.compile_stats()`
+    exposes the tally.
     """
     if step_fn is None:
         return lambda fn: wrap(fn, mesh=mesh, batch_axes=batch_axes, fsdp=fsdp,
                                state_sharding=state_sharding,
                                donate_state=donate_state,
-                               static_argnums=static_argnums)
+                               static_argnums=static_argnums,
+                               watchdog=watchdog, max_cache=max_cache)
 
     mesh = mesh or default_mesh()
     data_sharding = NamedSharding(mesh, batch_spec(batch_axes))
@@ -216,7 +271,34 @@ def wrap(step_fn: tp.Optional[tp.Callable] = None, *,
             return fsdp_sharding(state, mesh)
         return jax.tree_util.tree_map(lambda _: replicated, state)
 
-    compiled_cache: tp.Dict[tp.Any, tp.Callable] = {}
+    compiled_cache: tp.Dict[tp.Any, tp.Callable] = collections.OrderedDict()
+    # Unique per wrap instance so two wraps of same-named step functions
+    # never share (and cross-pollute) a watchdog entry.
+    watch_name = (f"wrap:{getattr(step_fn, '__name__', 'step')}"
+                  f"#{next(_wrap_ids)}")
+
+    last_watchdog: tp.List[tp.Optional[RecompileWatchdog]] = [None]
+
+    def resolve_watchdog() -> RecompileWatchdog:
+        if watchdog is not None:
+            return watchdog
+        from .. import observability
+        telemetry = observability.get_telemetry()
+        wd = telemetry.watchdog if telemetry is not None \
+            else _fallback_watchdog
+        previous = last_watchdog[0]
+        if previous is not None and previous is not wd:
+            # telemetry toggled mid-run: MOVE this wrap's tally to the
+            # new watchdog, or the fresh entry would restart the warm-up
+            # budget and swallow exactly the post-warm-up recompile the
+            # watchdog exists to report.
+            carried = previous.counts.pop(watch_name, None)
+            if carried is not None:
+                entry = wd._entry(watch_name)
+                for field, count in carried.items():
+                    entry[field] = entry.get(field, 0) + count
+        last_watchdog[0] = wd
+        return wd
 
     def wrapped(state, batch, *rest):
         # Key on structure AND leaf shapes/dtypes: resolved shardings
@@ -225,7 +307,18 @@ def wrap(step_fn: tp.Optional[tp.Callable] = None, *,
         key = (jax.tree_util.tree_structure(state),
                tuple((tuple(np.shape(leaf)), str(getattr(leaf, "dtype", type(leaf))))
                      for leaf in jax.tree_util.tree_leaves(state)))
-        if key not in compiled_cache:
+        wd = resolve_watchdog()
+        wd.note_call(watch_name)
+        missed = key not in compiled_cache
+        if not missed:
+            compiled_cache.move_to_end(key)
+        else:
+            if len(compiled_cache) >= max_cache:
+                evicted, _ = compiled_cache.popitem(last=False)
+                logger.warning(
+                    "wrap cache for %r exceeded max_cache=%d; evicting the "
+                    "least-recently-used executable (a recompile awaits its "
+                    "state shape).", watch_name, max_cache)
             sharding = resolve_state_sharding(state)
             # `None` legs leave the sharding to the partitioner (prefix
             # pytrees are allowed in jit shardings).
@@ -246,7 +339,44 @@ def wrap(step_fn: tp.Optional[tp.Callable] = None, *,
                 out_shardings=out_shardings,
                 donate_argnums=(0,) if donate_state else (),
                 static_argnums=static_argnums)
-        return compiled_cache[key](state, batch, *rest)
+        fn = compiled_cache[key]
+        # Count ACTUAL XLA compiles via the inner jit's cache growth
+        # (the same hook RecompileWatchdog.watch polls): a state-shape
+        # miss above compiles on this first call, but so does a changed
+        # batch/rest shape against a cached entry — the most common
+        # silent-recompile source, invisible to the key check alone.
+        cache_size = getattr(fn, "_cache_size", None)
+        if cache_size is None:
+            # no growth hook on this jax: fall back to miss counting
+            if missed:
+                wd.note_compile(watch_name, describe_abstract(
+                    (state, batch) + tuple(rest), {}))
+            return fn(state, batch, *rest)
+        before = cache_size()
+        out = fn(state, batch, *rest)
+        for _ in range(cache_size() - before):
+            wd.note_compile(watch_name, describe_abstract(
+                (state, batch) + tuple(rest), {}))
+        return out
+
+    def compile_stats() -> tp.Dict[str, int]:
+        """{calls, compiles, recompiles} of this wrapped step, as tallied
+        by whichever watchdog its cache misses were reported through."""
+        totals = {"calls": 0, "compiles": 0, "recompiles": 0}
+        candidates = [watchdog] if watchdog is not None else None
+        if candidates is None:
+            from .. import observability
+            telemetry = observability.get_telemetry()
+            candidates = [_fallback_watchdog] + (
+                [telemetry.watchdog] if telemetry is not None else [])
+        for wd in candidates:
+            entry = wd.counts.get(watch_name)
+            if entry:
+                for field in totals:
+                    totals[field] += entry[field]
+        return totals
 
     wrapped.mesh = mesh  # type: ignore[attr-defined]
+    wrapped.watchdog_name = watch_name  # type: ignore[attr-defined]
+    wrapped.compile_stats = compile_stats  # type: ignore[attr-defined]
     return wrapped

@@ -1,0 +1,380 @@
+# Cross-replica sharded weight update — the ZeRO-1/2 middle ground
+# between this package's two existing extremes. `fsdp_sharding` (ZeRO-3)
+# shards parameters themselves and pays an all-gather inside every
+# matmul; plain `wrap` (ZeRO-0) replicates everything and every chip
+# redundantly stores AND updates the full Adam moments. Following
+# "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+# Training" (arXiv:2004.13336), the profitable middle shards only the
+# *update*: reduce-scatter the gradients so each replica owns 1/N of
+# them, update only that shard of the optimizer state (and params),
+# all-gather the fresh parameters — compute stays replicated, optimizer
+# HBM drops by the data-axis size, and the wire bytes match plain
+# all-reduce (a reduce-scatter plus an all-gather IS a ring all-reduce
+# split in half around the update). Expressed declaratively as
+# shardings, XLA's latency-hiding scheduler overlaps both halves with
+# backward compute (arXiv:2204.06514) — no hand-written collectives in
+# the common path; `zero_update` is the explicit spelling for when the
+# partitioner needs help.
+"""ZeRO-1/2 sharded weight update over the data axis."""
+import math
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .data_parallel import axis_leaf_sharding
+from .mesh import default_mesh
+
+# Top-level state keys treated as weight-update (optimizer) state by
+# `zero_sharding`'s default and by `describe_state_sharding`'s grouping.
+# 'master' covers the ZeRO-2-style fp32 master-params convention.
+UPDATE_KEY_MARKERS = ("opt", "master")
+
+
+def _is_update_key(key: str) -> bool:
+    key = key.lower()
+    return any(marker in key for marker in UPDATE_KEY_MARKERS)
+
+
+def zero_sharding(state: tp.Any, mesh: tp.Optional[Mesh] = None, *,
+                  axis: str = "data", min_size: int = 2 ** 12,
+                  shard_keys: tp.Optional[tp.Sequence[str]] = None) -> tp.Any:
+    """Per-leaf NamedShardings for a ZeRO-1/2 sharded weight update.
+
+    When `state` is a mapping (the `wrap` convention: `{'params': ...,
+    'opt_state': ...}`), entries whose key names optimizer state
+    (contains 'opt' or 'master' — override with an explicit `shard_keys`
+    list) get their large leaves sharded over `axis` (largest divisible
+    dim, same rule as `fsdp_sharding`; leaves under `min_size` elements
+    stay replicated), and every other entry — the compute params — stays
+    fully replicated. A non-mapping `state` (e.g. a bare optax state
+    passed to `BaseSolver.set_state_sharding`) is treated wholly as
+    optimizer state.
+
+    The result is directly consumable as `wrap(step,
+    state_sharding=zero_sharding(state, mesh))`: the partitioner then
+    reduce-scatters gradients into each replica's shard, applies the
+    optimizer update shard-locally, and all-gathers the fresh params —
+    per-chip optimizer HBM divided by the axis size at (asymptotically)
+    the same wire bytes as the plain gradient all-reduce. ZeRO-2-style
+    fp32 master params shard the same way: keep them under a
+    `'master_params'` state key (or name it in `shard_keys`).
+    """
+    mesh = mesh or default_mesh()
+    shard_leaf = axis_leaf_sharding(mesh, axis, min_size)
+    replicated = NamedSharding(mesh, P())
+    if not isinstance(state, tp.Mapping):
+        return jax.tree_util.tree_map(shard_leaf, state)
+    keys = set(shard_keys) if shard_keys is not None else None
+
+    def for_entry(key: str, entry: tp.Any) -> tp.Any:
+        sharded = key in keys if keys is not None else _is_update_key(key)
+        rule = shard_leaf if sharded else (lambda _: replicated)
+        return jax.tree_util.tree_map(rule, entry)
+
+    return type(state)({key: for_entry(key, entry)
+                        for key, entry in state.items()})
+
+
+def zero_update(grad_fn: tp.Callable, optimizer: tp.Any, *,
+                mesh: tp.Optional[Mesh] = None, axis: str = "data",
+                min_size: int = 2 ** 12) -> tp.Callable:
+    """Explicit ZeRO-1 split-step: reduce-scatter grads, update the local
+    shard, all-gather params.
+
+    For when the declarative route (`wrap(...,
+    state_sharding=zero_sharding(...))`) leaves the partitioner
+    guessing: the returned step spells out the schedule with sharding
+    constraints, so XLA *must* lower the gradient reduction as a
+    reduce-scatter into the `axis` shard, run the optimizer math
+    shard-locally against the (equally sharded) moments, and re-gather
+    the fresh parameters.
+
+    `grad_fn(params, batch, *rest) -> (loss, grads)` is the
+    `jax.value_and_grad` convention, so microbatch accumulation composes
+    in front — `zero_update(with_grad_accumulation(jax.value_and_grad(
+    loss_fn), k), optimizer)` feeds the reduce-scatter ONCE per step
+    with the already-accumulated gradient, not once per microbatch.
+    Returns `step(state, batch, *rest) -> (state, {'loss': ...})` with
+    `state = {'params': ..., 'opt_state': ...}`; wrap it with
+    `wrap(step, state_sharding=zero_sharding(state, mesh))` (wrap's
+    default `donate_state=True` then donates the old shard buffers to
+    the new state).
+    """
+    mesh = mesh or default_mesh()
+    shard_leaf = axis_leaf_sharding(mesh, axis, min_size)
+    replicated = NamedSharding(mesh, P())
+
+    def step(state: tp.Mapping, batch: tp.Any, *rest: tp.Any):
+        params, opt_state = state["params"], state["opt_state"]
+        loss, grads = grad_fn(params, batch, *rest)
+        shard = jax.tree_util.tree_map(shard_leaf, grads)
+        # grads arrive as the per-replica partial sums of a data-sharded
+        # loss; constraining them to the shard layout makes the psum a
+        # reduce-scatter — each replica receives only its 1/N reduced.
+        grads = jax.lax.with_sharding_constraint(grads, shard)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        # the update math stays shard-local (moments are sharded the
+        # same way by zero_sharding on the wrapped state)...
+        updates = jax.lax.with_sharding_constraint(updates, shard)
+        import optax
+        params = optax.apply_updates(params, updates)
+        # ...and only the FRESH params are all-gathered, once.
+        params = jax.lax.with_sharding_constraint(
+            params, jax.tree_util.tree_map(lambda _: replicated, params))
+        new_state = dict(state)
+        new_state["params"] = params
+        new_state["opt_state"] = opt_state
+        return type(state)(new_state), {"loss": loss}
+
+    return step
+
+
+def per_device_bytes(tree: tp.Any) -> int:
+    """Bytes ONE device holds for `tree`: each `jax.Array` leaf counts
+    its per-device shard (via `sharding.shard_shape`, no data access);
+    host leaves count full size. The HBM-side evidence for ZeRO/FSDP
+    claims — a state sharded N ways over the data axis reports ~1/N of
+    its replicated footprint."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(tuple(shape))
+        total += math.prod(shape) * np.dtype(dtype).itemsize
+    return total
+
+
+def _leaf_axes(leaf: tp.Any) -> tp.Tuple[tp.Set[str], tp.Dict[str, int]]:
+    """Mesh axes a leaf's sharding spreads it over (+ their sizes)."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return set(), {}
+    axes: tp.Set[str] = set()
+    for part in spec:
+        if part is None:
+            continue
+        axes.update(part if isinstance(part, tuple) else (part,))
+    mesh = getattr(sharding, "mesh", None)
+    sizes = {name: int(mesh.shape[name]) for name in axes} \
+        if mesh is not None else {}
+    return axes, sizes
+
+
+def describe_state_sharding(state: tp.Any) -> tp.Dict[str, tp.Any]:
+    """Classify a state pytree's live placement for logs / checkpoints.
+
+    Returns `{'mode', 'param_axes', 'update_axes', 'axis_sizes',
+    'summary'}` where mode is one of:
+
+      * ``replicated`` — no leaf is sharded (ZeRO-0)
+      * ``zero1``      — params replicated, optimizer/master state
+                         sharded (ZeRO-1/2, this module's pattern)
+      * ``fsdp``       — the parameters themselves are sharded (ZeRO-3)
+
+    Grouping follows `UPDATE_KEY_MARKERS` on the top-level state key.
+    `BaseSolver.commit` persists this next to the checkpoint
+    (`checkpoint_meta.json`) so `python -m flashy_tpu.info` can show how
+    a restored solver's state is laid out.
+    """
+    param_axes: tp.Set[str] = set()
+    update_axes: tp.Set[str] = set()
+    axis_sizes: tp.Dict[str, int] = {}
+
+    def visit(path, leaf):
+        axes, sizes = _leaf_axes(leaf)
+        if not axes:
+            return
+        axis_sizes.update(sizes)
+        # A leaf is update state when ANY pytree key on its path names
+        # it (a solver may register 'opt_state' directly, or one
+        # combined attribute {'params': ..., 'opt_state': ...} — the
+        # discriminating key then sits a level down).
+        is_update = any(
+            _is_update_key(str(getattr(entry, "key",
+                                       getattr(entry, "name", entry))))
+            for entry in path)
+        (update_axes if is_update else param_axes).update(axes)
+
+    jax.tree_util.tree_map_with_path(visit, state)
+    if param_axes:
+        mode = "fsdp"
+        axes = param_axes | update_axes
+    elif update_axes:
+        mode = "zero1"
+        axes = update_axes
+    else:
+        return {"mode": "replicated", "param_axes": [], "update_axes": [],
+                "axis_sizes": {}, "summary": "replicated"}
+    detail = ",".join(f"{name}={axis_sizes[name]}" if name in axis_sizes
+                      else name for name in sorted(axes))
+    return {"mode": mode, "param_axes": sorted(param_axes),
+            "update_axes": sorted(update_axes), "axis_sizes": axis_sizes,
+            "summary": f"{mode}({detail})"}
+
+
+# ---------------------------------------------------------------------------
+# Measurement harness: `python -m flashy_tpu.parallel.zero` and the
+# bench.py `zero` leg both run this — step time + per-chip optimizer
+# HBM for replicated vs ZeRO-1 vs FSDP on a small Transformer LM, with
+# every compile reported through one RecompileWatchdog so "zero
+# post-warm-up recompiles" is an asserted property, not a hope.
+# ---------------------------------------------------------------------------
+
+def run_zero_bench(steps: int = 3, *, dim: int = 128, num_layers: int = 2,
+                   num_heads: int = 4, vocab_size: int = 512,
+                   batch: tp.Optional[int] = None, seq: int = 64,
+                   min_size: int = 2 ** 10) -> tp.Dict[str, tp.Any]:
+    """Measure the three weight-update layouts on one small LM.
+
+    Returns a record with ``opt_state_bytes_per_chip`` and ``step_ms``
+    dicts keyed by mode (``replicated``/``zero1``/``fsdp``),
+    ``opt_bytes_ratio_zero1`` (ZeRO-1 per-chip optimizer bytes over
+    replicated — ~1/N on an N-way data mesh), ``max_param_delta``
+    (ZeRO-1 vs replicated params after `steps` identical steps — the
+    numerical-equivalence check) and ``recompiles`` (watchdog total
+    past warm-up across every mode's run — 0 when shapes are stable).
+    """
+    import time
+
+    import optax
+
+    from ..models import TransformerConfig, TransformerLM
+    from ..observability import RecompileWatchdog
+    from ..utils import device_sync
+    from .data_parallel import fsdp_sharding, shard_batch, wrap
+    from .mesh import make_mesh
+
+    n_devices = len(jax.devices())
+    if batch is None:
+        batch = max(8, 2 * n_devices)
+    if batch % n_devices:
+        batch += n_devices - batch % n_devices
+
+    cfg = TransformerConfig(vocab_size=vocab_size, dim=dim,
+                            num_layers=num_layers, num_heads=num_heads,
+                            attention="dense")
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    tokens_host = rng.integers(0, vocab_size, (batch, seq)).astype(np.int32)
+    init = jax.tree_util.tree_map(np.asarray, {"params": model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]})
+    optim = optax.adamw(1e-3)
+
+    def make_state():
+        # fresh host-side state per mode: wrap donates its input buffers
+        params = jax.tree_util.tree_map(jnp.asarray, init)
+        return {"params": params, "opt_state": optim.init(params)}
+
+    def step(state, tokens):
+        def loss_fn(variables):
+            logits = model.apply(variables, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt_state = optim.update(grads, state["opt_state"],
+                                          state["params"])
+        return ({"params": optax.apply_updates(state["params"], updates),
+                 "opt_state": opt_state}, {"loss": loss})
+
+    watchdog = RecompileWatchdog(warmup=1)
+    mesh_data = make_mesh({"data": n_devices})
+    mesh_fsdp = make_mesh({"fsdp": n_devices})
+    # Each mode's initial state is device_put onto the SAME shardings
+    # wrap resolves, so step 1 already runs at the steady-state
+    # placement — otherwise the second call legitimately retraces for
+    # the committed sharded inputs and "zero recompiles" cannot hold.
+    zero_spec = zero_sharding(make_state(), mesh_data, min_size=min_size)
+    modes: tp.Dict[str, tp.Tuple[tp.Callable, Mesh, tp.Tuple[str, ...],
+                                 tp.Callable]] = {
+        "replicated": (wrap(step, mesh=mesh_data, batch_axes=("data",),
+                            watchdog=watchdog), mesh_data, ("data",),
+                       lambda s: jax.device_put(s, jax.tree_util.tree_map(
+                           lambda _: NamedSharding(mesh_data, P()), s))),
+        "zero1": (wrap(step, mesh=mesh_data, batch_axes=("data",),
+                       state_sharding=zero_spec,
+                       watchdog=watchdog), mesh_data, ("data",),
+                  lambda s: jax.device_put(s, zero_spec)),
+        "fsdp": (wrap(step, mesh=mesh_fsdp, batch_axes=("fsdp",), fsdp=True,
+                      watchdog=watchdog), mesh_fsdp, ("fsdp",),
+                 lambda s: jax.device_put(s, fsdp_sharding(s, mesh_fsdp))),
+    }
+
+    result: tp.Dict[str, tp.Any] = {
+        "n_devices": n_devices, "batch": batch, "seq": seq,
+        "opt_state_bytes_per_chip": {}, "step_ms": {}, "sharding": {},
+    }
+    final_params: tp.Dict[str, tp.Any] = {}
+    for name, (wrapped, mesh, batch_axes, place) in modes.items():
+        state = place(make_state())
+        tokens = shard_batch(jnp.asarray(tokens_host), mesh,
+                             batch_axes=batch_axes)
+        state, aux = wrapped(state, tokens)  # compile + step 1
+        device_sync(aux["loss"])
+        begin = time.perf_counter()
+        for _ in range(steps):
+            state, aux = wrapped(state, tokens)
+        device_sync(aux["loss"])
+        result["step_ms"][name] = round(
+            (time.perf_counter() - begin) / steps * 1e3, 2)
+        result["opt_state_bytes_per_chip"][name] = per_device_bytes(
+            state["opt_state"])
+        result["sharding"][name] = describe_state_sharding(state)["summary"]
+        final_params[name] = jax.tree_util.tree_map(np.asarray,
+                                                    state["params"])
+
+    deltas = jax.tree_util.tree_map(
+        lambda a, b: float(np.max(np.abs(a - b))),
+        final_params["replicated"], final_params["zero1"])
+    result["max_param_delta"] = max(jax.tree_util.tree_leaves(deltas))
+    opt_bytes = result["opt_state_bytes_per_chip"]
+    result["opt_bytes_ratio_zero1"] = round(
+        opt_bytes["zero1"] / opt_bytes["replicated"], 4)
+    result["recompiles"] = sum(watchdog.summary().values())
+    return result
+
+
+def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
+    """`python -m flashy_tpu.parallel.zero [--steps N]`: run the
+    three-layout measurement and print one JSON line; exit 1 when ZeRO-1
+    drifts numerically from the replicated path or any post-warm-up
+    recompile was reported."""
+    import argparse
+    import json
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m flashy_tpu.parallel.zero",
+        description="ZeRO-1 vs replicated vs FSDP weight-update bench.")
+    parser.add_argument("--steps", type=int, default=3)
+    parser.add_argument("--seq", type=int, default=64)
+    args = parser.parse_args(argv)
+
+    result = run_zero_bench(steps=args.steps, seq=args.seq)
+    print(json.dumps(result), flush=True)
+    problems = []
+    if result["recompiles"]:
+        problems.append(f"{result['recompiles']} post-warm-up recompiles")
+    if result["max_param_delta"] > 1e-4:
+        problems.append(f"ZeRO-1 params drifted from replicated by "
+                        f"{result['max_param_delta']:.2e}")
+    n = result["n_devices"]
+    if n >= 2 and result["opt_bytes_ratio_zero1"] > (1.5 / n + 0.25):
+        problems.append(
+            f"ZeRO-1 opt-state per chip is {result['opt_bytes_ratio_zero1']}"
+            f"x replicated on a {n}-way mesh — the shard did not happen")
+    for problem in problems:
+        print(f"zero bench FAILED: {problem}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

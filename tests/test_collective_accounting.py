@@ -258,6 +258,49 @@ def test_async_start_counts_match_sync_lowering():
     assert async_var["all-reduce"]["bytes"] == (64 + 32) * 4
 
 
+def test_reduce_scatter_sync_and_async_conventions():
+    """reduce-scatter joins the table with the same sync-equivalent
+    rule: the `-start` output tuple aliases the UNREDUCED full-gradient
+    operand ahead of the 1/N result shard, so counting the whole tuple
+    would overstate the ZeRO-1 update's traffic by exactly the factor
+    the sharded update removes."""
+    from flashy_tpu.parallel.accounting import collective_stats
+
+    sync = collective_stats(
+        "%rs = f32[8,16]{1,0} reduce-scatter(%x), channel_id=1")
+    assert sync["reduce-scatter"] == {"count": 1, "bytes": 8 * 16 * 4}
+
+    async_ = collective_stats(
+        # (operand alias, result shard): only the shard counts
+        "%rs = (f32[64,16]{1,0}, f32[8,16]{1,0}) "
+        "reduce-scatter-start(%x), channel_id=1\n"
+        "%rsd = f32[8,16]{1,0} reduce-scatter-done(%rs)")
+    assert async_["reduce-scatter"] == sync["reduce-scatter"]
+
+    # variadic: (in1, in2, out1, out2) -> the two output shards only
+    stats = collective_stats(
+        "%rs = (f32[64,16]{1,0}, bf16[64,16]{1,0}, /*index=2*/f32[8,16]{1,0}, "
+        "/*index=3*/bf16[8,16]{1,0}) reduce-scatter-start(%x, %y), "
+        "channel_id=2")
+    assert stats["reduce-scatter"] == {"count": 1,
+                                       "bytes": 8 * 16 * 4 + 8 * 16 * 2}
+
+
+def test_compare_collective_stats_reports_delta():
+    from flashy_tpu.parallel.accounting import compare_collective_stats
+
+    replicated = ("%ar = f32[64]{0} all-reduce(%g), channel_id=1")
+    zero1 = ("%rs = f32[8]{0} reduce-scatter(%g), channel_id=1\n"
+             "%ag = f32[64]{0} all-gather(%p), channel_id=2")
+    delta = compare_collective_stats(zero1, replicated)
+    assert delta == {
+        "all-reduce": {"count": -1, "bytes": -64 * 4},
+        "reduce-scatter": {"count": 1, "bytes": 8 * 4},
+        "all-gather": {"count": 1, "bytes": 64 * 4},
+    }
+    assert compare_collective_stats(replicated, replicated) == {}
+
+
 def test_scalar_payload_async_start_counts_like_sync():
     """collective-permute of a scalar s32 counter: every element of the
     async output tuple is a 32-bit scalar, so shape alone cannot tell

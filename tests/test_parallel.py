@@ -232,6 +232,56 @@ def test_grad_accumulation_matches_full_batch():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_grad_accumulation_bf16_accumulates_in_f32():
+    # Regression: the accumulator used to inherit the grad dtype, so
+    # bf16 grads were summed in bf16 — every addend loses its low
+    # mantissa bits once the partial sum grows, and past ~8 microbatches
+    # the accumulated gradient visibly drifts. The fix sums in f32 and
+    # casts back, so the result must track the f32 full-batch reference
+    # far inside the drift the naive bf16 running sum shows.
+    from flashy_tpu.parallel import with_grad_accumulation
+
+    num_micro = 16
+    # one big addend, then a tail of small ones: at a bf16 running sum
+    # of magnitude ~100 the spacing is 0.5, so every later 0.25 addend
+    # rounds away entirely — 15 microbatches of gradient silently lost.
+    rows = np.full((num_micro, 8), 0.25, np.float32)
+    rows[0] = 100.0
+    batch = jnp.asarray(rows)  # microbatch size 1: mean(0) = the row
+    w = jnp.ones((8,), jnp.bfloat16)
+
+    def value_and_grad(w, batch):
+        # mean loss whose grad is the per-row mean of the batch, in the
+        # params' bf16 dtype — the shape of a mixed-precision train step
+        grads = jnp.mean(batch, axis=0).astype(jnp.bfloat16)
+        loss = jnp.mean(batch).astype(jnp.bfloat16)
+        return loss, grads
+
+    loss, grads = jax.jit(with_grad_accumulation(
+        value_and_grad, num_micro))(w, batch)
+    assert grads.dtype == jnp.bfloat16  # contract: output dtype unchanged
+
+    ref = rows.mean(axis=0)  # exact in f32: 6.484375
+
+    # the naive bf16 running sum (what the code used to do)
+    naive = jnp.zeros((8,), jnp.bfloat16)
+    for k in range(num_micro):
+        naive = naive + jnp.asarray(rows[k]).astype(jnp.bfloat16)
+    naive = np.asarray((naive / num_micro).astype(np.float32))
+
+    fixed_err = np.max(np.abs(np.asarray(grads, np.float32) - ref))
+    naive_err = np.max(np.abs(naive - ref))
+    # the drift is real past ~8 microbatches (here: the whole small-grad
+    # tail vanished, ~3.5% of the gradient)...
+    assert naive_err > 0.1, naive_err
+    # ...while the f32 accumulator only pays the final bf16 rounding
+    assert fixed_err <= 0.016, fixed_err
+    assert fixed_err < naive_err / 10, (fixed_err, naive_err)
+
+    # loss accumulates in f32 too
+    assert abs(float(loss) - float(ref[0])) < 0.05
+
+
 def test_grad_accumulation_identity_for_one():
     from flashy_tpu.parallel import with_grad_accumulation
     fn = jax.value_and_grad(lambda w, b: (w * b).sum())
