@@ -46,10 +46,20 @@ zero-demo:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m flashy_tpu.parallel.zero --steps 3
 
+# Streaming-datapipe drill on CPU: pack a synthetic jsonl+npy corpus
+# mixture into fixed [B, L] segment-masked batches, train a tiny LM,
+# kill it with a simulated SIGTERM mid-stream, resume from the
+# committed input cursor, and demand the consumed token stream be
+# IDENTICAL to an uninterrupted run with zero post-warm-up recompiles
+# (exit 1 on any violation). Seconds; also run by the tests workflow.
+datapipe-demo:
+	JAX_PLATFORMS=cpu python -m flashy_tpu.datapipe
+
 docs:
 	python tools/gendocs.py -o docs/api -p flashy_tpu \
 		-c 'flashy_tpu.observability*' -c 'flashy_tpu.serve*' \
-		-c 'flashy_tpu.resilience*' -c 'flashy_tpu.parallel*'
+		-c 'flashy_tpu.resilience*' -c 'flashy_tpu.parallel*' \
+		-c 'flashy_tpu.datapipe*'
 
 native:
 	python tools/build_native.py
@@ -57,4 +67,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all coverage bench serve-demo chaos-demo zero-demo docs native dist
+.PHONY: default linter tests tests-all coverage bench serve-demo chaos-demo zero-demo datapipe-demo docs native dist

@@ -759,6 +759,23 @@ def bench_zero(jax, on_tpu: bool):
     return result
 
 
+def bench_datapipe(jax, on_tpu: bool):
+    """Packing throughput of the streaming data pipeline (host-side:
+    jsonl+npy shard read -> weighted mixture -> fixed [B, L] sequence
+    packing -> background prefetch). Reports host tokens/s and packing
+    efficiency (non-padding fraction) — the number to compare against
+    the LM leg's device tokens/s: the pipeline must outrun the step
+    function or data_wait eats the MXU."""
+    del jax  # host-only leg
+    from flashy_tpu.datapipe.__main__ import run_packing_bench
+    result = run_packing_bench(batches=200 if on_tpu else 100,
+                               batch_size=8, seq_len=512)
+    log(f"datapipe: {result.get('tokens_per_sec')} packed tokens/s host-side "
+        f"({result.get('batch_shape')} batches, efficiency "
+        f"{result.get('packing_efficiency')})")
+    return result
+
+
 def bench_ring(jax, on_tpu: bool):
     """Ring attention (shard_map + pallas per-block kernel) vs the plain
     flash kernel at the same global shape. With one attached chip the
@@ -981,6 +998,7 @@ _COMPACT_KEYS = {
     "zero": ("opt_bytes_ratio_zero1", "step_ms_zero1", "step_ms_replicated",
              "recompiles"),
     "ring": ("overhead_pct",),
+    "datapipe": ("tokens_per_sec", "packing_efficiency"),
     "gan": ("steps_per_sec",),
     "decode": ("tokens_per_sec_per_chip",),
     "host_sync": ("gib_per_sec",),
@@ -1071,7 +1089,8 @@ def _persist_partial(extra: dict) -> None:
 _LEGS_FILTER = os.environ.get("FLASHY_TPU_BENCH_LEGS")
 LEG_ORDER = tuple(
     name for name in ("smoke", "mxu", "cifar", "lm", "attention", "zero",
-                      "ring", "gan", "decode", "host_sync", "all_reduce")
+                      "ring", "gan", "decode", "datapipe", "host_sync",
+                      "all_reduce")
     if _LEGS_FILTER is None or name in _LEGS_FILTER.split(","))
 
 
@@ -1130,6 +1149,7 @@ def child_main() -> None:
         "ring": lambda: bench_ring(jax, on_tpu),
         "decode": lambda: bench_decode(jax, on_tpu),
         "gan": lambda: bench_gan(jax, on_tpu),
+        "datapipe": lambda: bench_datapipe(jax, on_tpu),
         "host_sync": lambda: bench_host_sync(jax, on_tpu),
         "all_reduce": lambda: bench_all_reduce(jax),
     }

@@ -61,6 +61,12 @@ class ShardedSampler:
 
     def __init__(self, length: int, shard_index: int = 0, num_shards: int = 1,
                  shuffle: bool = True, seed: int = 0):
+        if length <= 0:
+            # An empty dataset would yield an empty shard on every
+            # process; steps containing collectives would then deadlock
+            # the pod silently. Fail loudly at construction instead.
+            raise ValueError(f"ShardedSampler needs a non-empty dataset, "
+                             f"got length={length}")
         self.length = length
         self.shard_index = shard_index
         self.num_shards = num_shards
@@ -179,6 +185,11 @@ class DataLoader:
             raise ValueError("pad_to_even is an eval mode; the training "
                              "path (shuffle=True) already pads via its "
                              "sampler")
+        if len(dataset) == 0:
+            # Downstream this surfaces as an empty shard: a process with
+            # zero batches skips its collectives and deadlocks the rest
+            # of the pod. Refuse at construction, where it is debuggable.
+            raise ValueError("DataLoader got an empty dataset")
         self.batch_size = batch_size
         self.collate_fn = collate_fn
         self.num_workers = num_workers
@@ -243,7 +254,9 @@ class DataLoader:
             try:
                 yield from (fetch(s, executor.map) for s in starts)
             finally:
-                executor.shutdown(wait=False)
+                # cancel_futures: without it, workers keep fetching into
+                # an abandoned epoch after the consumer stops early.
+                executor.shutdown(wait=False, cancel_futures=True)
         else:
             yield from (fetch(s, map) for s in starts)
 
@@ -268,7 +281,8 @@ class DataLoader:
             try:
                 yield from (fetch(b, executor.map) for b in batches)
             finally:
-                executor.shutdown(wait=False)
+                # see _iter_padded: abandoned-epoch fetches are cancelled
+                executor.shutdown(wait=False, cancel_futures=True)
         else:
             yield from (fetch(b, map) for b in batches)
 
@@ -310,16 +324,47 @@ def prefetch_to_device(iterator: tp.Iterable[tp.Any], size: int = 2,
     queue: collections.deque = collections.deque()
     iterator = iter(iterator)
     tracer = _data_tracer()
+    # Checkpointable sources (flashy_tpu.datapipe stages): batches
+    # staged in the device buffer have already advanced the source's
+    # cursor, so each entry carries the cursor AFTER its batch and an
+    # early stop rewinds to the last batch actually DELIVERED —
+    # otherwise up to `size` batches would be silently skipped on every
+    # abandoned iteration, breaking the datapipe's token-exact resume.
+    checkpointable = (hasattr(iterator, "state_dict")
+                      and hasattr(iterator, "load_state_dict"))
+    last_state = iterator.state_dict() if checkpointable else None
 
     def enqueue(batch):
+        state = iterator.state_dict() if checkpointable else None
         with _span(tracer, "data/host_to_device"):
-            queue.append(shard_batch(batch, mesh=mesh, batch_axes=batch_axes))
+            queue.append((shard_batch(batch, mesh=mesh,
+                                      batch_axes=batch_axes), state))
+
+    def deliver():
+        nonlocal last_state
+        batch, state = queue.popleft()
+        last_state = state
+        return batch
 
     try:
-        while True:
-            while len(queue) < size:
-                enqueue(next(iterator))
-            yield queue.popleft()
-    except StopIteration:
-        while queue:
-            yield queue.popleft()
+        try:
+            while True:
+                while len(queue) < size:
+                    enqueue(next(iterator))
+                yield deliver()
+        except StopIteration:
+            while queue:
+                yield deliver()
+    finally:
+        # A consumer stopping early (break, exception, GC of this
+        # generator) must release the source's resources — loader worker
+        # pools, datapipe prefetch threads. Generators and datapipe
+        # stages both expose close(); plain iterators have nothing to
+        # release. close() runs FIRST (a datapipe prefetch rewinds to
+        # its own consumed cursor there), then the undelivered buffered
+        # batches are replayed by rewinding past them.
+        close = getattr(iterator, "close", None)
+        if close is not None:
+            close()
+        if checkpointable and queue:
+            iterator.load_state_dict(last_state)

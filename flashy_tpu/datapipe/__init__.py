@@ -1,0 +1,14 @@
+# The streaming data pipeline (stream -> mix -> pack -> prefetch), the
+# production LM counterpart of `flashy_tpu.data`'s map-style loaders.
+# Every stage implements the CheckpointableIterator protocol, so the
+# OUTERMOST stage registered via `BaseSolver.register_stateful` makes
+# `commit()` persist the exact input cursor — a preempted run resumes
+# token-exact mid-epoch (`python -m flashy_tpu.datapipe` is the
+# acceptance drill proving it).
+# flake8: noqa
+"""flashy_tpu.datapipe: sharded streaming, packing, mixtures, exact resume."""
+from .iterator import CheckpointableIterator, PipelineStage
+from .mixture import MixtureStream
+from .packing import SequencePacker
+from .prefetch import PrefetchIterator, prefetch
+from .stream import ShardedTextStream

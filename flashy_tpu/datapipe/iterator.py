@@ -1,0 +1,73 @@
+# The durability contract of the streaming data pipeline. The solver's
+# epoch is an atomic commit unit (flashy semantics); a stream-shaped
+# input has no natural epoch boundary, so the INPUT CURSOR must be
+# committed with the model state — otherwise a preempted run silently
+# re-reads or skips samples between the last commit and the kill point.
+# Every pipeline stage therefore implements the same
+# state_dict/load_state_dict pair the rest of the framework checkpoints
+# through (flashy_tpu.state.StateDictSource): register the OUTERMOST
+# stage with `BaseSolver.register_stateful` and `commit()` persists the
+# exact cursor of every stage below it, recursively.
+"""CheckpointableIterator: the exact-resume protocol of every stage."""
+import typing as tp
+
+T = tp.TypeVar("T")
+
+
+@tp.runtime_checkable
+class CheckpointableIterator(tp.Protocol[T]):
+    """An iterator whose position can be checkpointed and restored.
+
+    The contract, shared by every `flashy_tpu.datapipe` stage:
+
+    * `state_dict()` describes the cursor AS OF THE ITEMS ALREADY
+      YIELDED to the caller — not items fetched ahead internally (the
+      prefetch stage buffers; its state tracks consumption).
+    * `load_state_dict(state)` repositions the iterator (and,
+      recursively, its sources) so the next `__next__` returns exactly
+      the item an uninterrupted run would have produced next.
+    * `close()` releases background resources (threads, file handles);
+      idempotent.
+
+    Any object with these methods qualifies (`runtime_checkable`
+    structural protocol) — which is also exactly what
+    `flashy_tpu.state.StateDictSource` needs, so a pipeline registered
+    via `BaseSolver.register_stateful` is committed and restored in
+    place like any other stateful attribute.
+    """
+
+    def __iter__(self) -> tp.Iterator[T]:
+        ...
+
+    def __next__(self) -> T:
+        ...
+
+    def state_dict(self) -> tp.Dict[str, tp.Any]:
+        ...
+
+    def load_state_dict(self, state: tp.Dict[str, tp.Any]) -> None:
+        ...
+
+    def close(self) -> None:
+        ...
+
+
+class PipelineStage:
+    """Minimal base for datapipe stages: iterator plumbing + close
+    fan-out to the source. Subclasses implement `__next__`,
+    `state_dict` and `load_state_dict` (the cursor semantics are the
+    interesting part and never generic)."""
+
+    source: tp.Optional[tp.Any] = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Release the source's resources (recursively); idempotent."""
+        close = getattr(self.source, "close", None)
+        if close is not None:
+            close()

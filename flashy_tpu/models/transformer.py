@@ -92,7 +92,8 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
-                 train: bool = False) -> jax.Array:
+                 train: bool = False,
+                 segment_ids: tp.Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim), axis=-1,
                               use_bias=False, dtype=cfg.dtype, name="qkv")(x)
@@ -100,7 +101,29 @@ class Attention(nn.Module):
         q = _rotary(q, positions)
         k = _rotary(k, positions)
 
-        if cfg.attention in ("ring", "ring_fused"):
+        if segment_ids is not None:
+            # Packed batches (datapipe.SequencePacker): tokens may only
+            # attend within their own segment, so documents packed into
+            # one row never see each other. Routed through the dense
+            # masked path — the pallas flash / ring kernels take no mask
+            # (packed training rows are max_len-sized, so the O(T^2)
+            # score block is the moderate, static-shape case). Ring
+            # attention exists to SHARD the sequence axis; silently
+            # materializing full unsharded [B,H,T,T] scores on exactly
+            # those long-context configs would be an OOM far from its
+            # cause — refuse loudly instead.
+            if cfg.attention in ("ring", "ring_fused"):
+                raise ValueError(
+                    f"segment_ids is not supported with attention="
+                    f"{cfg.attention!r}: segment-aware masking uses the "
+                    "dense O(T^2) path, which cannot shard the sequence "
+                    "axis; use attention='dense' (or 'flash', which falls "
+                    "back to dense under a mask) for packed batches.")
+            segment_mask = (segment_ids[:, :, None]
+                            == segment_ids[:, None, :])[:, None]  # [B,1,T,T]
+            out = dot_product_attention(q, k, v, causal=cfg.causal,
+                                        mask=segment_mask)
+        elif cfg.attention in ("ring", "ring_fused"):
             from ..parallel import ring_self_attention
             out = ring_self_attention(
                 q, k, v, mesh=self.mesh, causal=cfg.causal,
@@ -140,10 +163,12 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
-                 train: bool = False) -> jax.Array:
+                 train: bool = False,
+                 segment_ids: tp.Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         x = x + Attention(cfg, mesh=self.mesh, name="attn")(
-            nn.RMSNorm(dtype=cfg.dtype, name="norm1")(x), positions, train)
+            nn.RMSNorm(dtype=cfg.dtype, name="norm1")(x), positions, train,
+            segment_ids)
         normed = nn.RMSNorm(dtype=cfg.dtype, name="norm2")(x)
         if cfg.moe_experts > 0:
             x = x + MoEMLP(dim=cfg.dim, hidden=cfg.dim * cfg.mlp_ratio,
@@ -183,10 +208,10 @@ class _CarryBlock(nn.Module):
     train: bool = False
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, segment_ids=None):
         block = _remat(self.config) if self.config.remat else Block
         y = block(self.config, mesh=self.mesh, name="block")(
-            x, positions, self.train)
+            x, positions, self.train, segment_ids)
         return y, None
 
 
@@ -200,7 +225,13 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens: jax.Array,
                  positions: tp.Optional[jax.Array] = None,
                  train: bool = False,
-                 return_hidden: bool = False) -> tp.Any:
+                 return_hidden: bool = False,
+                 segment_ids: tp.Optional[jax.Array] = None) -> tp.Any:
+        """Apply the LM. With `segment_ids` ([B, T] int, 0 = padding,
+        1-based per packed document — the `datapipe.SequencePacker`
+        layout), attention is segment-aware: packed documents never
+        attend across their boundaries; pass the packer's per-segment
+        `positions` alongside so rotary phases restart per document."""
         cfg = self.config
         if tokens.shape[1] > cfg.max_seq_len:
             raise ValueError(
@@ -224,12 +255,12 @@ class TransformerLM(nn.Module):
                 in_axes=nn.broadcast,
                 length=cfg.num_layers)
             x, _ = scan_block(cfg, mesh=self.mesh, train=train,
-                              name="blocks")(x, positions)
+                              name="blocks")(x, positions, segment_ids)
         else:
             block = _remat(cfg) if cfg.remat else Block
             for layer in range(cfg.num_layers):
                 x = block(cfg, mesh=self.mesh, name=f"block_{layer}")(
-                    x, positions, train)
+                    x, positions, train, segment_ids)
         x = nn.RMSNorm(dtype=cfg.dtype, name="norm_f")(x)
         if return_hidden:
             # Skip the head: the caller contracts against the tied

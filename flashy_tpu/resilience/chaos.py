@@ -148,8 +148,10 @@ def fault_point(site: str, **context: tp.Any) -> None:
     to leave in production IO paths. Sites in the framework today:
     ``ckpt.write`` (single-file + slot state pickles), ``ckpt.manifest``,
     ``ckpt.pointer``, ``ckpt.load``, ``history.write``,
-    ``logger.<backend>`` (per-backend metric fan-out), and the chaos
-    drill's ``drill.step``.
+    ``logger.<backend>`` (per-backend metric fan-out), the chaos
+    drill's ``drill.step``, and the datapipe drill's ``datapipe.batch``
+    (one tick per consumed packed batch — the mid-stream kill point of
+    ``python -m flashy_tpu.datapipe``).
     """
     if _injector is not None:
         _injector.tick(site, **context)

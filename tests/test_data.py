@@ -247,3 +247,113 @@ def test_loader_pad_to_even_matches_unsharded_eval():
             num += metrics["y"] * count
             den += count
     assert abs(num / den - expected) < 1e-12
+
+
+def test_empty_dataset_rejected_at_construction():
+    # An empty shard silently skips collectives downstream and deadlocks
+    # the pod; both entry points must refuse it loudly instead.
+    import pytest
+    with pytest.raises(ValueError, match="empty dataset"):
+        DataLoader(SquareDataset(0), batch_size=2)
+    with pytest.raises(ValueError, match="non-empty"):
+        ShardedSampler(0, 0, 2)
+
+
+def test_prefetch_to_device_closes_source_on_early_stop():
+    mesh = make_mesh({"data": -1})
+    closed = []
+
+    def source():
+        try:
+            for i in range(100):
+                yield {"x": np.full((8, 3), i, dtype=np.float32)}
+        finally:
+            closed.append(True)
+
+    it = prefetch_to_device(source(), size=2, mesh=mesh, batch_axes=("data",))
+    next(it)
+    it.close()  # consumer stops early: break / GC of the generator
+    assert closed == [True]
+
+
+def test_prefetch_to_device_closes_datapipe_stage_on_early_stop():
+    from flashy_tpu.datapipe import SequencePacker, prefetch
+
+    class Docs:
+        def __init__(self):
+            self.i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.i += 1
+            return np.arange(4, dtype=np.int32)
+
+        def state_dict(self):
+            return {"i": self.i}
+
+        def load_state_dict(self, state):
+            self.i = state["i"]
+
+        def close(self):
+            self.closed = True
+
+    docs = Docs()
+    pipe = prefetch(SequencePacker(docs, batch_size=8, max_len=8), size=2)
+    mesh = make_mesh({"data": -1})
+    it = prefetch_to_device(pipe, size=1, mesh=mesh, batch_axes=("data",))
+    next(it)
+    it.close()
+    assert pipe._thread is None  # prefetch worker joined
+    assert getattr(docs, "closed", False)
+
+
+def test_loader_worker_pool_released_on_early_stop():
+    # cancel_futures=True: breaking out of a threaded epoch must not
+    # leave workers fetching into the abandoned iterator.
+    loader = DataLoader(SquareDataset(64), batch_size=4, shuffle=True,
+                        num_workers=2, seed=0)
+    it = iter(loader)
+    next(it)
+    it.close()  # triggers the generator's finally -> executor shutdown
+    # a fresh full iteration still works (no wedged pool state)
+    assert len(list(loader)) == len(loader)
+
+
+def test_prefetch_to_device_rewinds_undelivered_buffer():
+    # Batches staged in the device deque advanced the datapipe cursor
+    # but were never delivered; an early stop must rewind past them or
+    # every abandoned epoch silently skips `size` batches.
+    from flashy_tpu.datapipe import SequencePacker, prefetch
+
+    class Docs:
+        def __init__(self):
+            self.i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            doc = np.full(8, self.i, dtype=np.int32)
+            self.i += 1
+            return doc
+
+        def state_dict(self):
+            return {"i": self.i}
+
+        def load_state_dict(self, state):
+            self.i = state["i"]
+
+        def close(self):
+            pass
+
+    pipe = prefetch(SequencePacker(Docs(), batch_size=8, max_len=8), size=2)
+    mesh = make_mesh({"data": -1})
+    it = prefetch_to_device(pipe, size=2, mesh=mesh, batch_axes=("data",))
+    seen = [int(np.asarray(next(it)["tokens"])[0, 0]) for _ in range(2)]
+    it.close()  # deque still holds 2 staged-but-undelivered batches
+    seen += [int(np.asarray(next(pipe)["tokens"])[0, 0]) for _ in range(3)]
+    pipe.close()
+    # doc ids are consumed 8 per batch: batches start at docs 0,8,16,...
+    assert seen == [0, 8, 16, 24, 32]  # no gap where the deque was dropped

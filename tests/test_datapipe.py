@@ -1,0 +1,401 @@
+# Tests for the streaming data pipeline: disjoint per-host file shards,
+# static-shape sequence packing with segment ids, counter-keyed mixture
+# sampling, and — the subsystem's contract — exact mid-epoch resume of
+# every stage's cursor through a real BaseSolver commit()/restore()
+# cycle.
+import json
+
+import numpy as np
+import pytest
+
+from flashy_tpu.datapipe import (CheckpointableIterator, MixtureStream,
+                                 SequencePacker, ShardedTextStream, prefetch)
+
+
+class ListStream:
+    """Minimal in-memory CheckpointableIterator over a doc list."""
+
+    def __init__(self, docs, loop=False):
+        self.docs = [np.asarray(d, dtype=np.int32) for d in docs]
+        self.loop = loop
+        self.i = 0
+        self.closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.i >= len(self.docs):
+            if not self.loop:
+                raise StopIteration
+            self.i = 0
+        doc = self.docs[self.i]
+        self.i += 1
+        return doc
+
+    def state_dict(self):
+        return {"i": self.i}
+
+    def load_state_dict(self, state):
+        self.i = state["i"]
+
+    def close(self):
+        self.closed = True
+
+
+def _write_corpus(root, n_jsonl=3, docs_per_file=5):
+    files = []
+    token = 0
+    for shard in range(n_jsonl):
+        path = root / f"shard{shard:02d}.jsonl"
+        with open(path, "w") as f:
+            for _ in range(docs_per_file):
+                docs = list(range(token, token + 4))
+                token += 4
+                f.write(json.dumps({"tokens": docs}) + "\n")
+        files.append(path)
+    return files
+
+
+# ---------------------------------------------------------------------------
+# ShardedTextStream
+# ---------------------------------------------------------------------------
+def test_stream_shards_are_disjoint_and_cover(tmp_path):
+    files = _write_corpus(tmp_path, n_jsonl=4)
+    seen = []
+    for rank in range(2):
+        stream = ShardedTextStream(files, shard_index=rank, num_shards=2)
+        seen.append([tuple(doc) for doc in stream])
+    assert not set(seen[0]) & set(seen[1])  # disjoint slices
+    assert len(seen[0]) + len(seen[1]) == 20  # full coverage
+
+
+def test_stream_round_robin_interleaves_files(tmp_path):
+    files = _write_corpus(tmp_path, n_jsonl=2, docs_per_file=2)
+    docs = [tuple(doc) for doc in ShardedTextStream(files)]
+    # file0 doc0, file1 doc0, file0 doc1, file1 doc1
+    assert docs == [(0, 1, 2, 3), (8, 9, 10, 11),
+                    (4, 5, 6, 7), (12, 13, 14, 15)]
+
+
+def test_stream_formats_jsonl_text_and_npy(tmp_path):
+    jsonl = tmp_path / "a.jsonl"
+    jsonl.write_text(json.dumps({"text": "hi"}) + "\n")
+    npy = tmp_path / "b.npy"
+    np.save(npy, np.array([[5, 6, -1, -1], [7, -1, -1, -1]]))
+    docs = [list(doc) for doc in ShardedTextStream([jsonl, npy])]
+    assert docs == [[ord("h"), ord("i")], [5, 6], [7]]
+
+
+def test_stream_loop_and_exact_resume(tmp_path):
+    files = _write_corpus(tmp_path, n_jsonl=2, docs_per_file=3)
+    stream = ShardedTextStream(files, loop=True)
+    first = [tuple(next(stream)) for _ in range(8)]
+    state = stream.state_dict()
+    tail = [tuple(next(stream)) for _ in range(5)]
+    fresh = ShardedTextStream(files, loop=True)
+    fresh.load_state_dict(state)
+    assert [tuple(next(fresh)) for _ in range(5)] == tail
+    assert state["passes"] == 1   # 8 docs consumed > one 6-doc pass
+    assert first[6:8] == first[:2]  # the loop replays the same order
+
+
+def test_stream_rejects_empty_and_layout_mismatch(tmp_path):
+    with pytest.raises(ValueError, match="empty shard list"):
+        ShardedTextStream([])
+    files = _write_corpus(tmp_path, n_jsonl=1)
+    with pytest.raises(ValueError, match="no shard files left"):
+        ShardedTextStream(files, shard_index=1, num_shards=2)
+    stream = ShardedTextStream(files)
+    with pytest.raises(ValueError, match="sharding layout"):
+        stream.load_state_dict({"cursors": [0, 0], "rr": 0, "passes": 0,
+                                "num_files": 2})
+
+
+def test_stream_accepts_directory(tmp_path):
+    _write_corpus(tmp_path, n_jsonl=2, docs_per_file=1)
+    assert len(list(ShardedTextStream(tmp_path))) == 2
+
+
+# ---------------------------------------------------------------------------
+# SequencePacker
+# ---------------------------------------------------------------------------
+def test_packer_static_shapes_and_segments():
+    source = ListStream([[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]], loop=True)
+    packer = SequencePacker(source, batch_size=2, max_len=8)
+    batch = next(packer)
+    for key in ("tokens", "segment_ids", "positions"):
+        assert batch[key].shape == (2, 8)
+        assert batch[key].dtype == np.int32
+    row_tokens, row_segs, row_pos = (batch[k][0] for k in
+                                     ("tokens", "segment_ids", "positions"))
+    # [1,2,3] then [4,5] then [6,7,8] would split -> fresh row instead
+    assert list(row_tokens[:5]) == [1, 2, 3, 4, 5]
+    assert list(row_segs) == [1, 1, 1, 2, 2, 0, 0, 0]
+    assert list(row_pos) == [0, 1, 2, 0, 1, 0, 0, 0]
+    assert list(batch["tokens"][1][:4]) == [6, 7, 8, 9]
+
+
+def test_packer_splits_long_docs():
+    source = ListStream([list(range(10))], loop=False)
+    packer = SequencePacker(source, batch_size=1, max_len=4,
+                            drop_last=False)
+    batches = list(packer)
+    tokens = np.concatenate([b["tokens"][r] for b in batches
+                             for r in range(1)])
+    kept = tokens[np.concatenate([b["segment_ids"][0] for b in batches]) > 0]
+    assert list(kept) == list(range(10))
+    # each max_len chunk is its own segment with positions from 0
+    assert list(batches[0]["segment_ids"][0]) == [1, 1, 1, 1]
+    assert list(batches[0]["positions"][0]) == [0, 1, 2, 3]
+
+
+def test_packer_deterministic_and_drop_last():
+    docs = [list(range(i % 7 + 1)) for i in range(23)]
+    a = list(SequencePacker(ListStream(docs), batch_size=2, max_len=8))
+    b = list(SequencePacker(ListStream(docs), batch_size=2, max_len=8))
+    assert len(a) == len(b) > 0
+    for x, y in zip(a, b):
+        assert np.array_equal(x["tokens"], y["tokens"])
+        assert np.array_equal(x["segment_ids"], y["segment_ids"])
+
+
+def test_packer_resume_mid_buffer():
+    source = ListStream([list(range(i, i + 5)) for i in range(0, 200, 5)])
+    packer = SequencePacker(source, batch_size=2, max_len=8)
+    first = [next(packer) for _ in range(3)]
+    state = packer.state_dict()
+    tail = [next(packer) for _ in range(3)]
+    fresh = SequencePacker(
+        ListStream([list(range(i, i + 5)) for i in range(0, 200, 5)]),
+        batch_size=2, max_len=8)
+    fresh.load_state_dict(state)
+    for want, got in zip(tail, [next(fresh) for _ in range(3)]):
+        assert np.array_equal(want["tokens"], got["tokens"])
+    del first
+
+
+def test_packer_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        SequencePacker(ListStream([[1]]), batch_size=0, max_len=8)
+
+
+# ---------------------------------------------------------------------------
+# MixtureStream
+# ---------------------------------------------------------------------------
+def test_mixture_weights_converge():
+    a = ListStream([[0]], loop=True)
+    b = ListStream([[1]], loop=True)
+    mixture = MixtureStream([a, b], [0.8, 0.2], seed=3)
+    draws = [int(next(mixture)[0]) for _ in range(2000)]
+    frac = draws.count(1) / len(draws)
+    assert 0.15 < frac < 0.25  # ~0.2 +- sampling noise
+
+
+def test_mixture_deterministic_and_exact_resume():
+    def build():
+        return MixtureStream([ListStream([[i] for i in range(50)]),
+                              ListStream([[100 + i] for i in range(50)])],
+                             [0.5, 0.5], seed=7)
+
+    first = build()
+    head = [int(next(first)[0]) for _ in range(20)]
+    state = first.state_dict()
+    tail = [int(next(first)[0]) for _ in range(20)]
+    again = build()
+    assert [int(next(again)[0]) for _ in range(20)] == head
+    fresh = build()
+    fresh.load_state_dict(state)
+    assert [int(next(fresh)[0]) for _ in range(20)] == tail
+
+
+def test_mixture_retires_exhausted_sources():
+    a = ListStream([[0]] * 3)
+    b = ListStream([[1]], loop=True)
+    mixture = MixtureStream([a, b], [0.9, 0.1], seed=0)
+    draws = [int(next(mixture)[0]) for _ in range(50)]
+    assert draws.count(0) == 3      # a fully consumed, then retired
+    assert set(draws[-10:]) == {1}  # only b remains
+
+
+def test_mixture_rejects_changed_weights_or_seed():
+    def build(weights=(0.5, 0.5), seed=7):
+        return MixtureStream([ListStream([[0]], loop=True),
+                              ListStream([[1]], loop=True)],
+                             list(weights), seed=seed)
+
+    state = build().state_dict()
+    build().load_state_dict(state)  # unchanged config round-trips
+    with pytest.raises(ValueError, match="changed mixture config"):
+        build(weights=(0.9, 0.1)).load_state_dict(state)
+    with pytest.raises(ValueError, match="changed mixture config"):
+        build(seed=8).load_state_dict(state)
+
+
+def test_mixture_zero_weight_source_never_blocks_termination():
+    # a weight-0 source is never drawable; once every weighted source
+    # is exhausted the stream must END, not spin or divide by zero
+    weighted = ListStream([[0]] * 4)
+    dead_weight = ListStream([[1]], loop=True)
+    mixture = MixtureStream([weighted, dead_weight], [1.0, 0.0], seed=0)
+    assert [int(d[0]) for d in mixture] == [0, 0, 0, 0]
+
+
+def test_mixture_validates_arguments():
+    with pytest.raises(ValueError):
+        MixtureStream([], [])
+    with pytest.raises(ValueError):
+        MixtureStream([ListStream([[1]])], [1.0, 2.0])
+    with pytest.raises(ValueError):
+        MixtureStream([ListStream([[1]])], [-1.0])
+
+
+# ---------------------------------------------------------------------------
+# prefetch
+# ---------------------------------------------------------------------------
+def test_prefetch_transparent_and_exact_resume():
+    docs = [list(range(i, i + 3)) for i in range(0, 300, 3)]
+    plain = SequencePacker(ListStream(docs), batch_size=2, max_len=8)
+    fetched = prefetch(
+        SequencePacker(ListStream(docs), batch_size=2, max_len=8), size=3)
+    direct = [next(plain) for _ in range(4)]
+    buffered = [next(fetched) for _ in range(4)]
+    for want, got in zip(direct, buffered):
+        assert np.array_equal(want["tokens"], got["tokens"])
+    # state reflects CONSUMED batches, not whatever was fetched ahead
+    state = fetched.state_dict()
+    resumed = prefetch(
+        SequencePacker(ListStream(docs), batch_size=2, max_len=8), size=3)
+    resumed.load_state_dict(state)
+    assert np.array_equal(next(plain)["tokens"], next(resumed)["tokens"])
+    fetched.close()
+    resumed.close()
+
+
+def test_prefetch_close_stops_worker_and_source():
+    source = ListStream([[1, 2]] * 10, loop=True)
+    packer = SequencePacker(source, batch_size=1, max_len=4)
+    pipe = prefetch(packer, size=2)
+    next(pipe)
+    pipe.close()
+    assert source.closed
+    assert pipe._thread is None
+    assert pipe.stats()["tokens"] == 4.0
+
+
+def test_prefetch_close_rewinds_readahead_for_reuse():
+    # close() mid-stream (what prefetch_to_device's early-stop finally
+    # does) must rewind the source past the drained read-ahead: resuming
+    # iteration on the same pipe may not skip the fetched-ahead batches.
+    docs = [[i, i] for i in range(100)]
+    pipe = prefetch(SequencePacker(ListStream(docs), batch_size=1,
+                                   max_len=2), size=3)
+    consumed = [int(next(pipe)["tokens"][0, 0]) for _ in range(2)]
+    pipe.close()
+    consumed += [int(next(pipe)["tokens"][0, 0]) for _ in range(3)]
+    pipe.close()
+    assert consumed == [0, 1, 2, 3, 4]  # no silent gap at the close
+
+
+def test_stream_rejects_renamed_file_set(tmp_path):
+    files = _write_corpus(tmp_path, n_jsonl=2)
+    state = ShardedTextStream(files).state_dict()
+    renamed = tmp_path / "other.jsonl"
+    files[1].rename(renamed)
+    fresh = ShardedTextStream([files[0], renamed])
+    with pytest.raises(ValueError, match="different shard files"):
+        fresh.load_state_dict(state)
+
+
+def test_prefetch_propagates_exhaustion_and_errors():
+    pipe = prefetch(SequencePacker(ListStream([[1, 2, 3]] * 4),
+                                   batch_size=2, max_len=4))
+    assert len(list(pipe)) == 2
+    pipe.close()
+
+    class Broken(ListStream):
+        def __next__(self):
+            raise RuntimeError("boom")
+
+    bad = prefetch(Broken([[1]]))
+    with pytest.raises(RuntimeError, match="boom"):
+        next(bad)
+    bad.close()
+
+
+def test_stages_satisfy_protocol():
+    stream = ListStream([[1]])
+    packer = SequencePacker(stream, batch_size=1, max_len=2)
+    assert isinstance(stream, CheckpointableIterator)
+    assert isinstance(packer, CheckpointableIterator)
+    assert isinstance(prefetch(packer), CheckpointableIterator)
+
+
+# ---------------------------------------------------------------------------
+# solver integration: the cursor rides commit()/restore()
+# ---------------------------------------------------------------------------
+def _make_stream_solver(tmp_path, consume_log):
+    from flashy_tpu.solver import BaseSolver
+
+    class StreamSolver(BaseSolver):
+        def __init__(self):
+            super().__init__()
+            docs = [list(range(i, i + 4)) for i in range(0, 400, 4)]
+            self.pipe = prefetch(
+                SequencePacker(ListStream(docs, loop=True),
+                               batch_size=2, max_len=8), size=2)
+            self.register_stateful("pipe")
+
+        def train_stage(self):
+            total = 0.0
+            for _ in range(3):
+                batch = next(self.pipe)
+                consume_log.append(batch["tokens"].copy())
+                total += float(batch["tokens"].sum())
+            return {"checksum": total}
+
+    return StreamSolver
+
+
+def test_cursor_roundtrip_through_commit_restore(tmp_path):
+    from flashy_tpu.xp import Config, create_xp
+
+    consumed_a: list = []
+    StreamSolver = _make_stream_solver(tmp_path, consumed_a)
+    xp = create_xp(Config({"t": "datapipe"}), root=tmp_path)
+    with xp.enter():
+        solver = StreamSolver()
+        solver.run_stage("train", solver.train_stage)
+        solver.commit()
+        # consume PAST the commit: these batches are after the durable
+        # cursor and must be replayed by the restored solver
+        solver.run_stage("train", solver.train_stage)
+        solver.pipe.close()
+    after_commit = consumed_a[3:]
+
+    consumed_b: list = []
+    StreamSolver = _make_stream_solver(tmp_path, consumed_b)
+    xp = create_xp(Config({"t": "datapipe"}), root=tmp_path)
+    with xp.enter():
+        resumed = StreamSolver()
+        assert resumed.restore()
+        assert resumed.epoch == 2
+        resumed.run_stage("train", resumed.train_stage)
+        resumed.pipe.close()
+    assert len(consumed_b) == len(after_commit) == 3
+    for want, got in zip(after_commit, consumed_b):
+        assert np.array_equal(want, got)
+
+
+def test_solver_registers_datapipe_for_preemption_close(tmp_path):
+    from flashy_tpu.xp import Config, create_xp
+
+    log: list = []
+    StreamSolver = _make_stream_solver(tmp_path, log)
+    xp = create_xp(Config({"t": "datapipe-close"}), root=tmp_path)
+    with xp.enter():
+        solver = StreamSolver()
+        pipes = solver._registered_datapipes()
+        assert [name for name, _ in pipes] == ["pipe"]
+        assert pipes[0][1] is solver.pipe
+        solver.pipe.close()

@@ -488,3 +488,60 @@ def test_remat_policy_unknown_raises():
     tokens = jnp.zeros((1, 16), jnp.int32)
     with pytest.raises(ValueError, match="remat_policy"):
         TransformerLM(cfg).init(jax.random.PRNGKey(0), tokens)
+
+
+def test_transformer_segment_mask_isolates_packed_docs():
+    # A packed row (two docs + padding, datapipe.SequencePacker layout)
+    # must produce, at each doc's positions, exactly the logits the doc
+    # gets when presented alone: the segment-aware mask makes packed
+    # neighbours invisible.
+    cfg = _tiny_cfg(dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    doc_a = jnp.asarray(rng.integers(1, 64, 5), jnp.int32)
+    doc_b = jnp.asarray(rng.integers(1, 64, 7), jnp.int32)
+    length = 16
+    tokens = jnp.zeros((1, length), jnp.int32)
+    tokens = tokens.at[0, :5].set(doc_a).at[0, 5:12].set(doc_b)
+    segments = jnp.asarray([[1] * 5 + [2] * 7 + [0] * 4], jnp.int32)
+    positions = jnp.asarray([list(range(5)) + list(range(7)) + [0] * 4],
+                            jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    packed = model.apply(variables, tokens, positions=positions,
+                         segment_ids=segments)
+    alone_a = model.apply(variables, doc_a[None])
+    alone_b = model.apply(variables, doc_b[None])
+    np.testing.assert_allclose(np.asarray(packed[0, :5]),
+                               np.asarray(alone_a[0]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(packed[0, 5:12]),
+                               np.asarray(alone_b[0]), atol=1e-5)
+    # without segment_ids the same inputs DO leak across the boundary
+    unmasked = model.apply(variables, tokens, positions=positions)
+    assert not np.allclose(np.asarray(unmasked[0, 5:12]),
+                           np.asarray(alone_b[0]), atol=1e-3)
+
+
+def test_transformer_segment_mask_scan_layers():
+    cfg = _tiny_cfg(dtype=jnp.float32, scan_layers=True)
+    model = TransformerLM(cfg)
+    tokens = jnp.asarray([[3, 4, 5, 6, 7, 8]], jnp.int32)
+    segments = jnp.asarray([[1, 1, 1, 2, 2, 0]], jnp.int32)
+    positions = jnp.asarray([[0, 1, 2, 0, 1, 0]], jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply(variables, tokens, positions=positions,
+                         segment_ids=segments)
+    alone = model.apply(variables, tokens[:, :3])
+    np.testing.assert_allclose(np.asarray(logits[0, :3]),
+                               np.asarray(alone[0]), atol=1e-5)
+
+
+def test_transformer_segment_ids_rejects_ring_attention():
+    model = TransformerLM(_tiny_cfg(attention="ring"))
+    tokens = jnp.ones((1, 8), jnp.int32)
+    segs = jnp.ones((1, 8), jnp.int32)
+    dense = TransformerLM(_tiny_cfg(dtype=jnp.float32))
+    variables = dense.init(jax.random.PRNGKey(0), tokens)
+    with pytest.raises(ValueError, match="segment_ids is not supported"):
+        model.init(jax.random.PRNGKey(0), tokens, segment_ids=segs)
+    # dense path still accepts packed inputs
+    dense.apply(variables, tokens, segment_ids=segs)
