@@ -23,12 +23,26 @@ coverage:
 bench:
 	python bench.py
 
-# Continuous-batching serving smoke demo on CPU: 32 staggered requests
-# through an 8-slot engine, outputs verified token-exact against
-# per-request generate(), zero post-warm-up recompiles (exit 1 on any
-# violation). A couple of minutes; also run by the tests workflow.
+# Continuous-batching serving smoke demo on CPU, all three legs: 32
+# staggered requests through an 8-slot engine (token-exact against
+# per-request generate(), zero post-warm-up recompiles), the
+# speculative leg (n-gram draft + chunked prefill, still token-exact,
+# acceptance over the floor), and the chunked-prefill stall-bound leg
+# (exit 1 on any violation). A couple of minutes; also run by the
+# tests workflow.
 serve-demo:
 	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --requests 32 --slots 8
+
+# Speculative decoding + chunked prefill gate on CPU: a repetitive
+# mixed-length workload through a chunked-prefill engine with the
+# n-gram draft must stay token-exact vs generate(), clear the
+# acceptance-rate floor, and trigger zero post-warm-up compiles across
+# admission/chunked prefill/verify/retirement; then a long prompt
+# admitted mid-decode must cost live slots at most one chunk of
+# prefill per tick (exit 1 on any violation). Seconds; also run by the
+# tests workflow.
+serve-spec-demo:
+	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --legs speculative,chunked
 
 # Fault-tolerance chaos drill on CPU: train with an injected transient
 # IO fault (must be absorbed by retry), a simulated mid-stage SIGTERM
@@ -67,4 +81,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all coverage bench serve-demo chaos-demo zero-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all coverage bench serve-demo serve-spec-demo chaos-demo zero-demo datapipe-demo docs native dist

@@ -90,6 +90,13 @@ def format_serve_status(status: dict) -> str:
             parts.append(f"{key}={status[key]:.1f}")
     if "occupancy_p50" in status:
         parts.append(f"occupancy_p50={status['occupancy_p50'] * 100:.0f}%")
+    if "acceptance_rate" in status:
+        # speculative decoding: kept drafts / proposed drafts, plus the
+        # per-step accepted-token p50 when present
+        parts.append(f"acceptance={status['acceptance_rate'] * 100:.0f}%")
+        if "accepted_per_step_p50" in status:
+            parts.append("accepted_per_step_p50="
+                         f"{status['accepted_per_step_p50']:.1f}")
     return "  ".join(parts) or "(empty serve.json)"
 
 

@@ -295,6 +295,112 @@ def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
     return logits, new_cache
 
 
+def speculative_acceptance(draft_tokens: jax.Array, logits: jax.Array, *,
+                           temperature: float = 0.0,
+                           draft_probs: tp.Optional[jax.Array] = None,
+                           rng: tp.Optional[jax.Array] = None,
+                           pad_token: int = 0
+                           ) -> tp.Tuple[jax.Array, jax.Array]:
+    """Longest-prefix acceptance of drafted tokens against target logits.
+
+    The verify forward scores a slot's last emitted token plus its k
+    drafted tokens in ONE `[B, k+1]` call; `logits[:, i]` is then the
+    target model's distribution for draft token i (and `logits[:, k]`
+    the "bonus" position after all k drafts). This function turns those
+    logits into the emitted tokens of a speculative step:
+
+    * Greedy (`temperature == 0`): draft token i is accepted iff it
+      equals `argmax(logits[:, i])` and every earlier draft was
+      accepted. The emitted tokens are exactly the target's greedy
+      tokens — accepted drafts ARE the argmax, and the first
+      disagreement (or the bonus position) contributes the argmax
+      token itself — so a speculative greedy decode is token-for-token
+      identical to `generate()`, whatever the draft proposed.
+    * Sampling (`temperature > 0`): classic rejection sampling. Draft
+      token x_i (proposal probability q_i(x_i), one-hot when
+      `draft_probs` is None — a deterministic draft like n-gram lookup
+      or a greedy draft model) is accepted with probability
+      `min(1, p_i(x_i) / q_i(x_i))`; the first rejection resamples from
+      the residual distribution `norm(max(0, p_i - q_i))`, and full
+      acceptance samples the bonus position from `p_k`. The emitted
+      tokens are an exact sample from the target distribution — the
+      rejection-sampling identity — so speculation changes throughput,
+      never the output law.
+
+    Everything is fixed-shape (`accepted` is data, never a shape), so
+    one compiled executable serves every acceptance outcome.
+
+    Args:
+        draft_tokens: [B, k] int drafted tokens.
+        logits: [B, k+1, V] target logits from the verify forward.
+        temperature: must match the sampling temperature of the serving
+            engine (0 = greedy).
+        draft_probs: optional [B, k, V] proposal distribution; None
+            means a deterministic proposal (one-hot at `draft_tokens`).
+        rng: PRNG key, required when `temperature > 0`.
+        pad_token: fills the out-token tail beyond the emitted span.
+
+    Returns:
+        (out_tokens, accepted): out_tokens [B, k+1] holds the emitted
+        tokens at indices 0..accepted (inclusive — index `accepted` is
+        the bonus/resampled token) and `pad_token` beyond; accepted [B]
+        counts the drafts kept (0..k).
+    """
+    batch, k = draft_tokens.shape
+    draft_tokens = draft_tokens.astype(jnp.int32)
+    idx = jnp.arange(k + 1, dtype=jnp.int32)[None]
+
+    if temperature <= 0.0:
+        target = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+        match = draft_tokens == target[:, :k]
+        accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=-1),
+                           axis=-1)
+        out = jnp.where(idx <= accepted[:, None], target, jnp.int32(pad_token))
+        return out, accepted
+
+    if rng is None:
+        raise ValueError("speculative_acceptance(temperature>0) resamples "
+                         "rejected positions and needs an explicit `rng`.")
+    probs = jax.nn.softmax(logits[:, :k] / temperature, axis=-1)  # [B, k, V]
+    p_x = jnp.take_along_axis(probs, draft_tokens[..., None],
+                              axis=-1)[..., 0]                    # [B, k]
+    if draft_probs is None:
+        vocab = logits.shape[-1]
+        q_full = jax.nn.one_hot(draft_tokens, vocab, dtype=probs.dtype)
+        q_x = jnp.ones_like(p_x)
+    else:
+        q_full = draft_probs.astype(probs.dtype)
+        q_x = jnp.take_along_axis(q_full, draft_tokens[..., None],
+                                  axis=-1)[..., 0]
+    key_u, key_s = jax.random.split(rng)
+    u = jax.random.uniform(key_u, draft_tokens.shape, dtype=probs.dtype)
+    # u < min(1, p/q)  <=>  u * q < p  (no division, q == 0 safe)
+    accept = u * q_x < p_x
+    accepted = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=-1),
+                       axis=-1)                                   # [B]
+
+    # Final-token distribution: the residual at the first rejected index
+    # (a rejection implies q(x) > p(x) there, so the residual has mass),
+    # or the plain bonus distribution after full acceptance.
+    rows = jnp.arange(batch)
+    at = jnp.clip(accepted, 0, k - 1)
+    residual = jnp.maximum(probs[rows, at] - q_full[rows, at], 0.0)
+    residual = residual / jnp.maximum(
+        jnp.sum(residual, axis=-1, keepdims=True), 1e-20)
+    bonus = jax.nn.softmax(logits[:, k] / temperature, axis=-1)
+    dist = jnp.where((accepted < k)[:, None], residual, bonus)
+    final = jax.random.categorical(
+        key_s, jnp.where(dist > 0, jnp.log(dist), -jnp.inf),
+        axis=-1).astype(jnp.int32)
+
+    padded_draft = jnp.concatenate(
+        [draft_tokens, jnp.full((batch, 1), pad_token, jnp.int32)], axis=1)
+    out = jnp.where(idx < accepted[:, None], padded_draft,
+                    jnp.where(idx == accepted[:, None], final[:, None],
+                              jnp.int32(pad_token)))
+    return out, accepted
+
+
 def nucleus_filter(logits: jax.Array, top_p: float) -> jax.Array:
     """Top-p (nucleus) logit filter, sort-once formulation.
 

@@ -41,10 +41,28 @@ def _load_disk_cache() -> tp.Dict[str, tp.List[int]]:
         return {}
 
 
+def _runtime_fingerprint() -> tp.Tuple[str, str]:
+    """(jax, jaxlib) version pair baked into every cache key.
+
+    Block-size winners are measurements of a SPECIFIC compiled kernel:
+    a jax/jaxlib upgrade can change the pallas lowering (or the
+    candidate's viability entirely), so a persisted winner must never
+    be replayed across runtimes — stale winners silently pessimize, or
+    worse, pick a tile the new lowering cannot fit in VMEM.
+    """
+    try:
+        import jaxlib
+        jaxlib_version = getattr(jaxlib, "__version__", "unknown")
+    except Exception:  # pragma: no cover - jaxlib always ships with jax
+        jaxlib_version = "unknown"
+    return (f"jax-{jax.__version__}", f"jaxlib-{jaxlib_version}")
+
+
 def _make_key(batch: int, seq_len: int, heads: int, head_dim: int,
               causal: bool, dtype: tp.Any, include_backward: bool) -> tp.Tuple:
-    return (jax.devices()[0].device_kind, batch, seq_len, heads, head_dim,
-            causal, str(jnp.dtype(dtype)), include_backward)
+    return _runtime_fingerprint() + (
+        jax.devices()[0].device_kind, batch, seq_len, heads, head_dim,
+        causal, str(jnp.dtype(dtype)), include_backward)
 
 
 def lookup_tuned_blocks(batch: int, seq_len: int, heads: int, head_dim: int, *,

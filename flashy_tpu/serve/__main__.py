@@ -1,11 +1,21 @@
 # The serving smoke demo — `python -m flashy_tpu.serve`, mirroring
 # `python -m flashy_tpu.info`'s role as a no-setup CLI. Runs the full
-# stack on CPU with a tiny randomly-initialized TransformerLM:
-# staggered requests with mixed prompt lengths through a slot engine,
-# then (--verify, the default) replays every request through plain
-# per-request generate() and demands token-exact agreement plus zero
-# post-warm-up recompiles of the decode step — the acceptance gate of
-# the serving subsystem, runnable anywhere in seconds.
+# stack on CPU with a tiny randomly-initialized TransformerLM in three
+# legs, each an acceptance gate runnable anywhere in seconds:
+#
+#  * batching    staggered mixed-length requests through a slot engine,
+#                token-exact vs per-request generate(), zero
+#                post-warm-up recompiles.
+#  * speculative the same contract under speculative decoding + chunked
+#                prefill: greedy output must stay token-exact on
+#                concurrent mixed-length requests WHATEVER the draft
+#                proposed, the n-gram draft's acceptance rate must
+#                clear a floor on the repetitive corpus, and admission,
+#                chunked prefill, verify, and retirement together must
+#                trigger zero post-warm-up compiles.
+#  * chunked     a long prompt admitted mid-decode must not stall live
+#                slots: every scheduler tick advances at most one chunk
+#                of prefill AND the live request emits on every tick.
 """`python -m flashy_tpu.serve`: CPU continuous-batching smoke demo."""
 import argparse
 import logging
@@ -13,6 +23,8 @@ import sys
 import typing as tp
 
 logger = logging.getLogger("flashy_tpu.serve.demo")
+
+LEGS = ("batching", "speculative", "chunked")
 
 
 def _build_model(vocab: int, seed: int):
@@ -127,6 +139,225 @@ def run_demo(requests: int = 32, slots: int = 8, verify: bool = True,
     return 1 if failures else 0
 
 
+def _repetitive_mix(n: int, vocab: int, seed: int):
+    """Mixed-length REPETITIVE workload for the speculative leg: each
+    prompt tiles a short random pattern, the regime prompt-lookup
+    drafting exists for (templated text, code, retrieval-stuffed
+    prompts). Token-exactness holds for ANY workload — repetition only
+    buys a meaningful acceptance rate to assert a floor on."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    # generations long enough that the steady-state (where lookup
+    # shines) dominates the per-request transient
+    lengths = [4, 6, 9, 12, 15]
+    news = [16, 20, 24]
+    out = []
+    for _ in range(n):
+        period = int(rng.integers(2, 5))
+        pattern = rng.integers(0, vocab, period).astype(np.int32)
+        length = int(rng.choice(lengths))
+        prompt = np.tile(pattern, length // period + 1)[:length]
+        out.append((prompt, int(rng.choice(news))))
+    return out
+
+
+def run_spec_demo(requests: int = 16, slots: int = 4, k: int = 4,
+                  chunk: int = 8, draft_kind: str = "ngram",
+                  accept_floor: float = 0.2, seed: int = 0,
+                  log: tp.Optional[logging.Logger] = None) -> int:
+    """Speculative decoding + chunked prefill acceptance gate.
+
+    Serves a repetitive mixed-length workload through a chunked-prefill
+    engine with a draft provider; exits 1 unless every output is
+    token-exact vs per-request `generate()`, the acceptance rate clears
+    `accept_floor`, and admission + chunked prefill + verify +
+    retirement together cause zero post-warm-up compiles.
+    """
+    import numpy as np
+    from ..models.decoding import generate
+    from .draft import ModelDraft, NGramDraft
+    from .engine import DecodeEngine
+    from .scheduler import ContinuousBatchingScheduler
+
+    log = log or logger
+    vocab = 64
+    model, params = _build_model(vocab, seed)
+    workload = _repetitive_mix(requests, vocab, seed + 1)
+
+    engine = DecodeEngine(model, params, slots=slots, spec_k=k, chunk=chunk)
+    if draft_kind == "ngram":
+        draft: tp.Any = NGramDraft(slots=slots, k=k, ngram=3)
+    elif draft_kind == "model":
+        # a half-size draft LM sharing the vocabulary (random init —
+        # its acceptance is poor, which is exactly the point: output
+        # must stay exact even under a bad draft; use --accept-floor 0)
+        import jax
+        import jax.numpy as jnp
+        from ..models import TransformerConfig, TransformerLM
+        dcfg = TransformerConfig(vocab_size=vocab, dim=16, num_layers=1,
+                                 num_heads=2, attention="dense",
+                                 max_seq_len=64, dtype=jnp.float32)
+        dmodel = TransformerLM(dcfg)
+        dparams = dmodel.init(jax.random.PRNGKey(seed + 13),
+                              jnp.ones((1, 8), jnp.int32))
+        draft = ModelDraft(dmodel, dparams, slots=slots, k=k)
+        draft.warmup(prompt_lengths=[len(p) for p, _ in workload])
+    else:
+        raise ValueError(f"unknown draft kind {draft_kind!r}")
+
+    log.info("speculative leg: warming %d-slot engine (k=%d, chunk=%d, "
+             "%s draft)...", slots, k, chunk, draft_kind)
+    engine.warmup()
+    warm_misses = engine.compile_cache.stats()["misses"]
+
+    scheduler = ContinuousBatchingScheduler(engine, draft=draft)
+    handles = []
+    pending = list(workload)
+    steps = 0
+    while pending or not scheduler.idle:
+        room = scheduler.max_queue - scheduler.queue_depth
+        for _ in range(min(2, len(pending), room)):
+            prompt, max_new = pending.pop(0)
+            handles.append(scheduler.submit(prompt, max_new))
+        scheduler.step()
+        steps += 1
+
+    stats = engine.compile_cache.stats()
+    post_warm_builds = stats["misses"] - warm_misses
+    summary = scheduler.metrics.summary()
+    log.info("speculative leg: %d requests in %d steps, acceptance "
+             "%.0f%% (%d drafted -> %d emitted), accepted/step "
+             "p50=%.1f p95=%.1f, itl p95 %.2fms",
+             len(handles), steps, summary["acceptance_rate"] * 100,
+             summary["spec_drafted"], summary["spec_emitted"],
+             summary["accepted_per_step_p50"],
+             summary["accepted_per_step_p95"], summary["itl_ms_p95"])
+
+    failures = 0
+    if not all(h.done for h in handles):
+        log.error("%d requests never finished",
+                  sum(not h.done for h in handles))
+        failures += 1
+    if stats["recompiles"] != 0 or post_warm_builds != 0:
+        log.error("speculative steady state was not compile-free: %d "
+                  "recompiles, %d post-warm-up builds (admission + "
+                  "chunked prefill + verify + retirement must all hit "
+                  "warmed shapes)", stats["recompiles"], post_warm_builds)
+        failures += 1
+    mismatches = 0
+    for handle in handles:
+        want = np.asarray(generate(model, params, handle.prompt[None],
+                                   max_new_tokens=handle.max_new_tokens))[0]
+        if not np.array_equal(handle.output, want):
+            mismatches += 1
+            log.error("request %d diverged from generate() under "
+                      "speculation:\n  served   %s\n  generate %s",
+                      handle.uid, handle.output.tolist(), want.tolist())
+    if mismatches:
+        failures += 1
+    else:
+        log.info("verified: all %d speculative outputs token-exact "
+                 "against per-request generate()", len(handles))
+    if summary["acceptance_rate"] < accept_floor:
+        log.error("acceptance rate %.2f below the %.2f floor — the "
+                  "draft is not earning its verify step on this corpus",
+                  summary["acceptance_rate"], accept_floor)
+        failures += 1
+    return 1 if failures else 0
+
+
+def run_chunked_demo(chunk: int = 8, seed: int = 0,
+                     log: tp.Optional[logging.Logger] = None) -> int:
+    """Chunked-prefill stall-bound gate: a long prompt admitted while
+    another slot is mid-decode must cost live slots at most one chunk
+    of prefill per tick — asserted structurally (prompt tokens advanced
+    per step <= chunk AND the live request emits on every tick of the
+    admission window) — and stay token-exact; exit 1 otherwise."""
+    import time
+
+    import numpy as np
+    from ..models.decoding import generate
+    from .engine import DecodeEngine
+    from .scheduler import ContinuousBatchingScheduler
+
+    log = log or logger
+    vocab = 64
+    model, params = _build_model(vocab, seed)
+    rng = np.random.default_rng(seed + 2)
+
+    engine = DecodeEngine(model, params, slots=2, chunk=chunk)
+    log.info("chunked leg: warming 2-slot engine (chunk=%d)...", chunk)
+    engine.warmup()
+    warm_misses = engine.compile_cache.stats()["misses"]
+    scheduler = ContinuousBatchingScheduler(engine)
+
+    short = scheduler.submit(rng.integers(0, vocab, 4).astype(np.int32),
+                             max_new_tokens=24)
+    for _ in range(3):  # the short request is actively decoding...
+        scheduler.step()
+    long_prompt = rng.integers(0, vocab, 5 * chunk).astype(np.int32)
+    long = scheduler.submit(long_prompt, max_new_tokens=4)
+
+    # ...when the long prompt lands: every tick of its prefill window
+    # must advance <= chunk prompt tokens AND still emit for the short
+    # request (the stall bound: one chunk's compute, not one prompt's).
+    failures = 0
+    ticks = 0
+    stalls = []
+    while long.state in ("queued", "prefilling"):
+        before = len(short.generated)
+        tick_start = time.perf_counter()
+        scheduler.step()
+        stalls.append(time.perf_counter() - tick_start)
+        ticks += 1
+        if scheduler.prefill_tokens_last_step > chunk:
+            log.error("tick advanced %d prompt tokens > chunk %d",
+                      scheduler.prefill_tokens_last_step, chunk)
+            failures += 1
+        if short.done:
+            break
+        if len(short.generated) <= before:
+            log.error("live request stalled on tick %d of the long "
+                      "prompt's prefill window", ticks)
+            failures += 1
+    scheduler.run()
+
+    stats = engine.compile_cache.stats()
+    post_warm_builds = stats["misses"] - warm_misses
+    expected_ticks = -(-long_prompt.size // chunk)  # ceil
+    log.info("chunked leg: %d-token prompt prefilled over %d ticks "
+             "(expected >= %d), live slot kept emitting, max tick "
+             "%.2fms, max prefill tokens/step %d (chunk %d)",
+             long_prompt.size, ticks, expected_ticks,
+             max(stalls) * 1e3 if stalls else 0.0,
+             scheduler.max_prefill_tokens_per_step, chunk)
+    if ticks < expected_ticks:
+        log.error("prefill finished in %d ticks < %d — chunks were not "
+                  "interleaved one per step", ticks, expected_ticks)
+        failures += 1
+    if scheduler.max_prefill_tokens_per_step > chunk:
+        log.error("max prefill tokens per step %d exceeds chunk %d",
+                  scheduler.max_prefill_tokens_per_step, chunk)
+        failures += 1
+    if stats["recompiles"] != 0 or post_warm_builds != 0:
+        log.error("chunked steady state was not compile-free: %d "
+                  "recompiles, %d post-warm-up builds",
+                  stats["recompiles"], post_warm_builds)
+        failures += 1
+    for handle, name in ((short, "short"), (long, "long")):
+        want = np.asarray(generate(model, params, handle.prompt[None],
+                                   max_new_tokens=handle.max_new_tokens))[0]
+        if not np.array_equal(handle.output, want):
+            log.error("%s request diverged from generate():\n"
+                      "  served   %s\n  generate %s", name,
+                      handle.output.tolist(), want.tolist())
+            failures += 1
+    if not failures:
+        log.info("verified: chunked admission mid-decode stayed "
+                 "token-exact with the stall bound held")
+    return 1 if failures else 0
+
+
 def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m flashy_tpu.serve",
@@ -141,13 +372,43 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                              "are deferred — the backpressure path)")
     parser.add_argument("--no-verify", dest="verify", action="store_false",
                         help="skip the per-request generate() comparison")
+    parser.add_argument("--legs", default="all",
+                        help="comma list of legs to run: "
+                             f"{','.join(LEGS)} (or 'all')")
+    parser.add_argument("--spec-k", type=int, default=4,
+                        help="tokens drafted per speculative step")
+    parser.add_argument("--chunk", type=int, default=8,
+                        help="prefill chunk size (speculative + chunked "
+                             "legs)")
+    parser.add_argument("--draft", default="ngram",
+                        choices=("ngram", "model"),
+                        help="draft provider for the speculative leg")
+    parser.add_argument("--accept-floor", type=float, default=0.2,
+                        help="minimum acceptance rate the speculative "
+                             "leg must clear (use 0 with --draft model: "
+                             "a random-init draft proposes noise)")
     args = parser.parse_args(argv)
+
+    legs = LEGS if args.legs == "all" else tuple(args.legs.split(","))
+    unknown = set(legs) - set(LEGS)
+    if unknown:
+        parser.error(f"unknown legs: {sorted(unknown)} (choose from {LEGS})")
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="[%(levelname)s] %(message)s")
-    return run_demo(requests=args.requests, slots=args.slots,
-                    verify=args.verify, seed=args.seed,
-                    stagger=args.stagger, max_queue=args.max_queue)
+    rc = 0
+    if "batching" in legs:
+        rc |= run_demo(requests=args.requests, slots=args.slots,
+                       verify=args.verify, seed=args.seed,
+                       stagger=args.stagger, max_queue=args.max_queue)
+    if "speculative" in legs:
+        rc |= run_spec_demo(requests=max(4, args.requests // 2),
+                            slots=max(2, args.slots // 2), k=args.spec_k,
+                            chunk=args.chunk, draft_kind=args.draft,
+                            accept_floor=args.accept_floor, seed=args.seed)
+    if "chunked" in legs:
+        rc |= run_chunked_demo(chunk=args.chunk, seed=args.seed)
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,218 @@
+# Draft providers for speculative decoding. The verify step
+# (engine.decode_speculative) is draft-agnostic: ANY proposal of k
+# tokens per slot is token-exact under greedy verification and
+# distribution-exact under rejection sampling — a better draft only
+# raises the acceptance rate, never changes the output. Two providers:
+# a dependency-free n-gram/prompt-lookup draft (host-side, zero device
+# work — the CPU-CI / demo workhorse, near-perfect on repetitive text)
+# and a small TransformerLM draft running the same slot-engine
+# machinery as the target. `k` is static per provider so the verify
+# executable compiles once; accepted counts are data, not shapes.
+"""Draft providers: n-gram prompt-lookup + small-model drafts."""
+import abc
+import logging
+import typing as tp
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class DraftProvider(abc.ABC):
+    """The contract between the scheduler and a draft source.
+
+    Lifecycle per request: `begin(slot, prompt, first_token)` when the
+    target's prefill completes; `propose()` once per speculative step
+    (an `[S, k]` proposal covering every slot — rows without a live
+    request are ignored by the verify mask); `observe(slot, tokens,
+    position)` with the tokens the verify step actually emitted and
+    the slot's new sequence length (this IS the rollback signal: a
+    model-backed draft resets its mirrored state here); `retire(slot)`
+    when the request finishes.
+    """
+
+    #: number of tokens proposed per slot per step (static)
+    k: int
+
+    def warmup(self, prompt_lengths: tp.Iterable[int] = ()) -> None:
+        """Pre-compile anything the provider runs on-device (no-op for
+        host-side drafts)."""
+
+    @abc.abstractmethod
+    def begin(self, slot: int, prompt: np.ndarray,
+              first_token: int) -> None:
+        """A request finished prefill into `slot`: seed the draft with
+        its prompt and the first generated token."""
+
+    @abc.abstractmethod
+    def propose(self) -> np.ndarray:
+        """[S, k] int32 proposed tokens for every slot."""
+
+    @abc.abstractmethod
+    def observe(self, slot: int, tokens: tp.Sequence[int],
+                position: int) -> None:
+        """Feed back the tokens the verify step emitted for a live
+        slot, plus the slot's new sequence length."""
+
+    @abc.abstractmethod
+    def retire(self, slot: int) -> None:
+        """The request in `slot` finished; drop its draft state."""
+
+
+class NGramDraft(DraftProvider):
+    """Prompt-lookup decoding: propose the continuation of the most
+    recent earlier occurrence of the slot's trailing n-gram.
+
+    Pure host-side list surgery — no parameters, no device work, no
+    dependencies — yet highly effective whenever the stream repeats
+    itself (code, templated text, retrieval-stuffed prompts, or a
+    greedy model that has settled into a cycle). Match length is tried
+    from `ngram` down to 1; no match proposes `k` repeats of the last
+    token (worst case: the verify step degrades to normal decoding
+    plus one masked forward, never to wrong output).
+
+    Args:
+        slots: S, the target engine's slot count.
+        k: tokens proposed per step.
+        ngram: longest trailing n-gram to look up (tried longest
+            first).
+        pad_token: fills rows without a live request.
+        window: lookup scans only the most recent `window` history
+            tokens — bounding the per-step host cost to O(S * window)
+            instead of growing with sequence length (matches on
+            kilotokens-old text rarely predict the next token better
+            than recent ones anyway).
+    """
+
+    def __init__(self, slots: int, k: int = 4, ngram: int = 2,
+                 pad_token: int = 0, window: int = 1024):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if ngram < 1:
+            raise ValueError(f"ngram must be >= 1, got {ngram}")
+        if window < ngram + 1:
+            raise ValueError(f"window must be > ngram, got {window}")
+        self.slots = slots
+        self.k = int(k)
+        self.ngram = int(ngram)
+        self.pad_token = int(pad_token)
+        self.window = int(window)
+        self._history: tp.Dict[int, tp.List[int]] = {}
+
+    def begin(self, slot: int, prompt: np.ndarray,
+              first_token: int) -> None:
+        self._history[slot] = [int(t) for t in np.asarray(prompt)]
+        self._history[slot].append(int(first_token))
+
+    def observe(self, slot: int, tokens: tp.Sequence[int],
+                position: int) -> None:
+        self._history[slot].extend(int(t) for t in tokens)
+
+    def retire(self, slot: int) -> None:
+        self._history.pop(slot, None)
+
+    def _lookup(self, history: tp.List[int]) -> tp.List[int]:
+        """k-token proposal from the most recent earlier occurrence of
+        the trailing n-gram (longest n first), scanning at most the
+        trailing `window` tokens."""
+        arr = np.asarray(history[-self.window:], np.int32)
+        size = arr.size
+        for n in range(min(self.ngram, size - 1), 0, -1):
+            key = arr[size - n:]
+            # most recent occurrence strictly before the trailing one
+            hits = np.flatnonzero(
+                (np.lib.stride_tricks.sliding_window_view(
+                    arr[:size - 1], n) == key).all(axis=1)) \
+                if size - 1 >= n else np.empty(0, np.int64)
+            if hits.size:
+                start = int(hits[-1]) + n
+                proposal = arr[start:start + self.k].tolist()
+                if proposal:
+                    while len(proposal) < self.k:  # pad with last token
+                        proposal.append(proposal[-1])
+                    return proposal
+        return [int(arr[-1])] * self.k  # no match: repeat-last fallback
+
+    def propose(self) -> np.ndarray:
+        out = np.full((self.slots, self.k), self.pad_token, np.int32)
+        for slot, history in self._history.items():
+            if history:
+                out[slot] = self._lookup(history)
+        return out
+
+
+class ModelDraft(DraftProvider):
+    """A small TransformerLM draft running its own slot engine.
+
+    The draft engine mirrors the target slot-for-slot (same S, same
+    per-request slot indices, its own KV cache and its own compile-
+    cache scope) and drafts k tokens by running its compiled `[S, 1]`
+    decode step k+1 times: the first k emissions are the proposal, and
+    the extra step exists purely to WRITE the k-th draft's K/V row —
+    on full acceptance the mirror's new position lands one past that
+    row, so skipping the write would leave a permanent hole below the
+    causal horizon that silently degrades every later proposal for
+    the slot (the extra emission is discarded). After each verify step
+    `observe()` rolls the mirror back to the accepted position (a pure
+    position reset — stale draft K/V rows beyond it are past every
+    causal horizon until the next propose overwrites them,
+    write-before-attend, exactly like the target's rollback).
+
+    The draft decodes greedily, i.e. the proposal is deterministic;
+    under a sampling target this is still exact rejection sampling
+    with a one-hot proposal (see `speculative_acceptance`).
+
+    Args:
+        model/params: the (small) draft TransformerLM + weights. Must
+            share the target's tokenizer/vocabulary.
+        slots: the TARGET engine's slot count.
+        k: tokens drafted per step.
+        max_seq_len/pad_token: as the target engine's.
+    """
+
+    def __init__(self, model, params, *, slots: int, k: int = 4,
+                 max_seq_len: tp.Optional[int] = None, pad_token: int = 0,
+                 cache_scope: str = "draft",
+                 compile_cache=None, tracer=None):
+        from .engine import DecodeEngine
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.k = int(k)
+        # the scope keeps the mirror's executables (and its entries in
+        # a telemetry-shared RecompileWatchdog) apart from the target
+        # engine's — colliding names would misreport the mirror's
+        # first compile as a target recompile.
+        self.engine = DecodeEngine(model, params, slots=slots,
+                                   max_seq_len=max_seq_len,
+                                   pad_token=pad_token,
+                                   cache_scope=cache_scope,
+                                   compile_cache=compile_cache,
+                                   tracer=tracer)
+
+    def warmup(self, prompt_lengths: tp.Iterable[int] = ()) -> None:
+        self.engine.warmup(prompt_lengths)
+
+    def begin(self, slot: int, prompt: np.ndarray,
+              first_token: int) -> None:
+        self.engine.acquire_slot(slot)
+        self.engine.prefill(slot, prompt)
+        # the draft's own first-token guess is irrelevant — the target
+        # already emitted the authoritative one; resync the mirror.
+        self.engine.set_slot_state(slot, int(first_token),
+                                   int(np.asarray(prompt).size))
+
+    def propose(self) -> np.ndarray:
+        # k+1 steps for k drafts: step i writes draft i-1's K/V before
+        # emitting draft i, so the LAST draft's row needs one more
+        # step. Without it, a fully-accepted span leaves row
+        # position-1 unwritten in the mirror — inside every future
+        # query's horizon.
+        columns = [self.engine.decode() for _ in range(self.k + 1)]
+        return np.stack(columns[:self.k], axis=1).astype(np.int32)
+
+    def observe(self, slot: int, tokens: tp.Sequence[int],
+                position: int) -> None:
+        self.engine.set_slot_state(slot, int(tokens[-1]), int(position))
+
+    def retire(self, slot: int) -> None:
+        self.engine.retire(slot)

@@ -5,9 +5,13 @@
 # — so the executable never recompiles as requests come and go, the
 # compiler-first caching discipline of the SSD/O(1)-cache line of work).
 # Prefill writes a new request's prompt K/V into its slot through
-# per-power-of-two-bucket executables, so the whole serving lifetime
-# touches a fixed, pre-warmable set of compiled shapes.
-"""DecodeEngine: fixed-slot KV cache + one static-shape decode step."""
+# per-power-of-two-bucket executables — or, in chunked mode, through
+# fixed [1, chunk] slices the scheduler interleaves with decode ticks —
+# and speculative decoding adds ONE [S, k+1] verify step that scores k
+# drafted tokens per slot per call (accepted counts are data, never
+# shapes), so the whole serving lifetime still touches a fixed,
+# pre-warmable set of compiled shapes.
+"""DecodeEngine: fixed-slot KV cache + static-shape decode/verify steps."""
 import logging
 import typing as tp
 
@@ -20,7 +24,9 @@ logger = logging.getLogger(__name__)
 
 # Tracer span/counter kinds for the serving path (category "serve").
 SPAN_PREFILL = "serve/prefill"
+SPAN_PREFILL_CHUNK = "serve/prefill_chunk"
 SPAN_DECODE = "serve/decode"
+SPAN_VERIFY = "serve/verify"
 
 
 class SlotAllocator:
@@ -52,10 +58,24 @@ class SlotAllocator:
     def live(self) -> tp.FrozenSet[int]:
         return frozenset(self._live)
 
-    def acquire(self) -> tp.Optional[int]:
-        if not self._free:
-            return None
-        slot = self._free.pop()
+    def acquire(self, slot: tp.Optional[int] = None) -> tp.Optional[int]:
+        """Claim the lowest free slot, or a SPECIFIC free slot.
+
+        The specific form exists for mirrored allocators (a draft
+        model's engine must hold exactly the slots the target engine
+        assigned — see serve/draft.py); asking for a live or
+        out-of-range slot raises, since a mirror drifting from its
+        target is a bug to fail loudly on."""
+        if slot is None:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._live.add(slot)
+            return slot
+        if slot not in self._free:
+            raise ValueError(f"slot {slot} is not free (live: "
+                             f"{sorted(self._live)})")
+        self._free.remove(slot)
         self._live.add(slot)
         return slot
 
@@ -88,10 +108,35 @@ class DecodeEngine:
         rng: PRNG key for sampling (required when temperature > 0).
         pad_token: token id emitted for inactive slots and used to pad
             prompts up to their bucket (never attended: causal mask).
+        chunk: when set, prompts prefill in fixed `[1, chunk]` slices
+            driven by `prefill_chunk()` instead of one monolithic
+            power-of-two bucket — the compiled prefill set shrinks to
+            {chunk} plus one `tail_bucket`, and a long prompt costs
+            many cheap ticks instead of one step-monopolizing call.
+            Must divide `max_seq_len` (keeps every slice inside the
+            cache without index clamping).
+        tail_bucket: the small second executable chunked prefill uses
+            when the remaining prompt fits it (defaults to
+            `min_bucket`); must be <= chunk.
+        spec_k: when set, `warmup()` also pre-compiles the `[S, k+1]`
+            speculative verify step for this draft length (the step
+            itself compiles on demand for any k — spec_k only moves
+            the compile to warm-up).
+        cache_scope: prefix for this engine's compile-cache keys (and
+            therefore its RecompileWatchdog entry names). REQUIRED
+            whenever two engines coexist in one process — different
+            models produce different executables under otherwise
+            identical keys, and even with separate caches the default
+            telemetry path shares one watchdog, where colliding names
+            would misreport a second engine's first compile as the
+            first engine's recompile. `ModelDraft` scopes its mirror
+            as "draft".
         compile_cache: a CompileCache to share; by default one is built
             against the active telemetry's watchdog/tracer
             (`observability.get_telemetry()`), falling back to a
             private watchdog so recompile accounting always works.
+            Only share a cache between engines whose `cache_scope`s
+            differ.
     """
 
     def __init__(self, model, params, *, slots: int,
@@ -100,6 +145,10 @@ class DecodeEngine:
                  rng: tp.Optional[tp.Any] = None,
                  pad_token: int = 0,
                  min_bucket: int = 4,
+                 chunk: tp.Optional[int] = None,
+                 tail_bucket: tp.Optional[int] = None,
+                 spec_k: tp.Optional[int] = None,
+                 cache_scope: str = "",
                  compile_cache: tp.Optional[CompileCache] = None,
                  tracer: tp.Optional[Tracer] = None):
         import jax
@@ -119,6 +168,25 @@ class DecodeEngine:
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.pad_token = int(pad_token)
         self.min_bucket = int(min_bucket)
+        self.chunk = int(chunk) if chunk is not None else None
+        if self.chunk is not None:
+            if self.chunk < 1 or self.max_seq_len % self.chunk != 0:
+                raise ValueError(
+                    f"chunk must divide max_seq_len "
+                    f"({self.max_seq_len}), got {self.chunk}: a slice "
+                    f"start past max_seq_len - chunk would clamp its "
+                    f"dynamic-update-slice and shift the K/V writes")
+            self.tail_bucket = int(tail_bucket if tail_bucket is not None
+                                   else min(self.min_bucket, self.chunk))
+            if not 1 <= self.tail_bucket <= self.chunk:
+                raise ValueError(f"tail_bucket must be in [1, chunk], got "
+                                 f"{self.tail_bucket} (chunk {self.chunk})")
+        else:
+            self.tail_bucket = None
+        self.spec_k = int(spec_k) if spec_k is not None else None
+        if self.spec_k is not None and self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
+        self.cache_scope = str(cache_scope)
         self.allocator = SlotAllocator(slots)
 
         if tracer is None or compile_cache is None:
@@ -141,6 +209,13 @@ class DecodeEngine:
         self._tokens = jnp.full((slots,), self.pad_token, jnp.int32)
         self._positions = jnp.full((slots,), self.max_seq_len, jnp.int32)
         self._active = jnp.zeros((slots,), bool)
+        # Host snapshot of positions/liveness. Every transition that
+        # moves a position (prefill, decode, verify, retire) is
+        # host-driven, so the mirror stays exact without ever reading
+        # the device arrays back — `slot_length()` used to cost one
+        # device->host sync per call, S syncs per scheduler step.
+        self._positions_host = np.full((slots,), self.max_seq_len, np.int64)
+        self._active_host = np.zeros((slots,), bool)
         # donation lets XLA update the cache in place on accelerators;
         # the CPU backend would only warn, so skip it there.
         self._donate = () if jax.default_backend() == "cpu" else (1,)
@@ -148,6 +223,12 @@ class DecodeEngine:
     # ------------------------------------------------------------------
     # compiled steps
     # ------------------------------------------------------------------
+    def _key(self, *parts: tp.Any) -> tp.Tuple[tp.Any, ...]:
+        """Compile-cache key for one of this engine's executables,
+        prefixed with `cache_scope` so co-resident engines (a draft
+        mirror) never collide in a shared cache or watchdog."""
+        return ((self.cache_scope,) if self.cache_scope else ()) + parts
+
     def _sample(self, logits, key):
         """Next token from [S, V] logits (matches generate()'s rule)."""
         import jax
@@ -203,6 +284,78 @@ class DecodeEngine:
 
         return jax.jit(prefill, donate_argnums=self._donate)
 
+    def _build_prefill_chunk(self, size: int) -> tp.Callable:
+        import jax
+        import jax.numpy as jnp
+        from ..models.decoding import _apply_step
+        model, cfg = self._model, self._cfg
+
+        def chunk_step(params, cache, tokens, start, used, slot, key):
+            # tokens: [1, size] right-padded slice of the prompt whose
+            # real tokens sit at absolute positions start..start+used-1.
+            # Unlike the bucketed prefill (fresh mini cache), a chunk
+            # must attend the slot's EARLIER chunks, so the slot's rows
+            # are sliced out of the big cache, advanced, and merged
+            # back. Pad rows beyond `used` are past every causal
+            # horizon until decode overwrites them — the same
+            # right-padding proof as the bucketed path.
+            def take(big):
+                starts = (0,) * (big.ndim - 4) + (slot, 0, 0, 0)
+                sizes = big.shape[:-4] + (1,) + big.shape[-3:]
+                return jax.lax.dynamic_slice(big, starts, sizes)
+
+            def merge(big, small):
+                starts = (0,) * (big.ndim - 4) + (slot, 0, 0, 0)
+                return jax.lax.dynamic_update_slice(
+                    big, small.astype(big.dtype), starts)
+
+            mini = jax.tree_util.tree_map(take, cache)
+            positions = (start + jnp.arange(size, dtype=jnp.int32))[None]
+            logits, mini = _apply_step(model, params, cfg, tokens,
+                                       positions, mini, start)
+            last = jax.lax.dynamic_index_in_dim(logits[0], used - 1,
+                                                axis=0, keepdims=True)
+            first = self._sample(last, key)[0]
+            cache = jax.tree_util.tree_map(merge, cache, mini)
+            return first, cache
+
+        return jax.jit(chunk_step, donate_argnums=self._donate)
+
+    def _build_verify(self, k: int) -> tp.Callable:
+        import jax
+        import jax.numpy as jnp
+        from ..models.decoding import _apply_step, speculative_acceptance
+        model, cfg, pad = self._model, self._cfg, self.pad_token
+
+        def verify(params, cache, tokens, drafts, positions, active, key):
+            # tokens/positions/active: [S]; drafts: [S, k]. ONE forward
+            # scores the last emitted token plus all k drafts per slot
+            # — k+1 cache rows written at each slot's own offset via
+            # the same per-row [B] cache-index path decode uses.
+            toks = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            pos = positions[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
+            logits, cache = _apply_step(model, params, cfg, toks, pos,
+                                        cache, positions)
+            out, accepted = speculative_acceptance(
+                drafts, logits, temperature=self.temperature,
+                rng=key if self.temperature > 0.0 else None, pad_token=pad)
+            out = jnp.where(active[:, None], out, jnp.int32(pad))
+            accepted = jnp.where(active, accepted, 0)
+            # Next-step state, computed on-device in the same call:
+            # the last emitted token (index `accepted` — the bonus or
+            # resampled token) and the position right after it. Rows
+            # past it hold stale draft K/V — beyond every causal
+            # horizon until overwritten, the rollback-for-free
+            # property of position-indexed caches.
+            last = jnp.take_along_axis(out, accepted[:, None],
+                                       axis=1)[:, 0]
+            new_tokens = jnp.where(active, last, jnp.int32(pad))
+            new_positions = jnp.where(active, positions + accepted + 1,
+                                      positions)
+            return out, accepted, new_tokens, new_positions, cache
+
+        return jax.jit(verify, donate_argnums=self._donate)
+
     def _next_key(self):
         import jax
         if self.temperature <= 0.0:
@@ -219,38 +372,66 @@ class DecodeEngine:
                              maximum=self.max_seq_len)
 
     def warmup(self, prompt_lengths: tp.Iterable[int] = ()) -> None:
-        """Pre-compile the decode step + the buckets covering
-        `prompt_lengths` (plus the minimum bucket), so live traffic
-        never waits on XLA. Runs each executable once on scratch inputs;
-        slot state is restored to empty afterwards.
+        """Pre-compile every executable live traffic can touch: the
+        decode step, the chunked-prefill pair (chunk + tail) or the
+        power-of-two buckets covering `prompt_lengths`, and — when
+        `spec_k` is set — the `[S, k+1]` speculative verify step. Runs
+        each once on scratch inputs; slot state is restored to empty
+        afterwards.
         """
         import jax.numpy as jnp
-        buckets = {self.min_bucket}
-        buckets.update(self.bucket_for(n) for n in prompt_lengths)
-        for bucket in sorted(buckets):
-            dummy = jnp.full((1, bucket), self.pad_token, jnp.int32)
-            _, self._cache = self.compile_cache.warm(
-                ("prefill", bucket), lambda: self._build_prefill(bucket),
-                self._params, self._cache, dummy, jnp.int32(1),
-                jnp.int32(0), self._next_key())
+        warmed = []
+        if self.chunk is not None:
+            # chunked mode: the whole prefill lifetime is two shapes
+            for size in sorted({self.chunk, self.tail_bucket}):
+                dummy = jnp.full((1, size), self.pad_token, jnp.int32)
+                _, self._cache = self.compile_cache.warm(
+                    self._key("prefill_chunk", size),
+                    lambda: self._build_prefill_chunk(size),
+                    self._params, self._cache, dummy, jnp.int32(0),
+                    jnp.int32(1), jnp.int32(0), self._next_key())
+                warmed.append(f"prefill_chunk/{size}")
+        else:
+            buckets = {self.min_bucket}
+            buckets.update(self.bucket_for(n) for n in prompt_lengths)
+            for bucket in sorted(buckets):
+                dummy = jnp.full((1, bucket), self.pad_token, jnp.int32)
+                _, self._cache = self.compile_cache.warm(
+                    self._key("prefill", bucket),
+                    lambda: self._build_prefill(bucket),
+                    self._params, self._cache, dummy, jnp.int32(1),
+                    jnp.int32(0), self._next_key())
+                warmed.append(f"prefill/{bucket}")
         _, self._cache = self.compile_cache.warm(
-            ("decode", self.slots), self._build_decode,
+            self._key("decode", self.slots), self._build_decode,
             self._params, self._cache, self._tokens, self._positions,
             self._active, self._next_key())
+        warmed.append(f"decode/{self.slots}")
+        if self.spec_k is not None:
+            dummy_drafts = jnp.full((self.slots, self.spec_k),
+                                    self.pad_token, jnp.int32)
+            *_, self._cache = self.compile_cache.warm(
+                self._key("verify", self.slots, self.spec_k),
+                lambda: self._build_verify(self.spec_k),
+                self._params, self._cache, self._tokens, dummy_drafts,
+                self._positions, self._active, self._next_key())
+            warmed.append(f"verify/{self.slots}/{self.spec_k}")
         # warm-up wrote scratch K/V at slot 0 position 0; a real prefill
         # overwrites it before that slot ever decodes, but reset the
         # host-visible state anyway so the engine starts pristine.
         self._tokens = jnp.full((self.slots,), self.pad_token, jnp.int32)
         self._positions = jnp.full((self.slots,), self.max_seq_len, jnp.int32)
         self._active = jnp.zeros((self.slots,), bool)
+        self._positions_host = np.full((self.slots,), self.max_seq_len,
+                                       np.int64)
+        self._active_host = np.zeros((self.slots,), bool)
         logger.info("serve warm-up done: %d executables (%s)",
-                    len(self.compile_cache),
-                    ", ".join(f"prefill/{b}" for b in sorted(buckets))
-                    + f", decode/{self.slots}")
+                    len(self.compile_cache), ", ".join(warmed))
 
-    def acquire_slot(self) -> tp.Optional[int]:
-        """Claim a free slot (None when all are live); prefill into it."""
-        return self.allocator.acquire()
+    def acquire_slot(self, slot: tp.Optional[int] = None) -> tp.Optional[int]:
+        """Claim a free slot (None when all are live); prefill into it.
+        A specific `slot` can be requested (mirrored draft engines)."""
+        return self.allocator.acquire(slot)
 
     def prefill(self, slot: int, prompt: np.ndarray) -> int:
         """Run `prompt` (1-D int tokens) into `slot`; returns the first
@@ -267,7 +448,8 @@ class DecodeEngine:
         padded = np.full((1, bucket), self.pad_token, np.int32)
         padded[0, :length] = prompt
         fn = self.compile_cache.get(
-            ("prefill", bucket), lambda: self._build_prefill(bucket))
+            self._key("prefill", bucket),
+            lambda: self._build_prefill(bucket))
         span = (self.tracer.span(SPAN_PREFILL, category="serve", slot=slot,
                                  bucket=bucket, length=length)
                 if self.tracer else _null_span())
@@ -279,13 +461,75 @@ class DecodeEngine:
         self._tokens = self._tokens.at[slot].set(first)
         self._positions = self._positions.at[slot].set(length)
         self._active = self._active.at[slot].set(True)
+        self._positions_host[slot] = length
+        self._active_host[slot] = True
         return first
+
+    def prefill_chunk(self, slot: int, prompt: np.ndarray,
+                      start: int) -> tp.Tuple[int, tp.Optional[int]]:
+        """Advance `slot`'s prefill by ONE fixed-size slice.
+
+        Processes `prompt[start : start + size]` where size is `chunk`,
+        or `tail_bucket` when the remainder fits it — so the compiled
+        prefill set in chunked mode is exactly those two shapes.
+        Returns `(next_start, first_token)`; `first_token` is None
+        until the final slice, at which point the slot goes live. The
+        scheduler interleaves these ticks with decode steps, bounding
+        the stall a long prompt can impose on live slots to one
+        slice's compute.
+        """
+        import jax.numpy as jnp
+        if self.chunk is None:
+            raise ValueError("engine was built without chunk=...; use "
+                             "prefill() for monolithic bucketed prefill")
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(f"prompt must be 1-D and non-empty, "
+                             f"got shape {prompt.shape}")
+        if slot not in self.allocator.live:
+            raise ValueError(f"slot {slot} was not acquired")
+        length = int(prompt.size)
+        if length > self.max_seq_len:
+            raise ValueError(f"prompt length {length} exceeds "
+                             f"max_seq_len {self.max_seq_len}")
+        if not 0 <= start < length:
+            raise ValueError(f"chunk start {start} outside prompt "
+                             f"[0, {length})")
+        remaining = length - start
+        size = self.tail_bucket if remaining <= self.tail_bucket \
+            else self.chunk
+        used = min(remaining, size)
+        final = start + used >= length
+        padded = np.full((1, size), self.pad_token, np.int32)
+        padded[0, :used] = prompt[start:start + used]
+        fn = self.compile_cache.get(
+            self._key("prefill_chunk", size),
+            lambda: self._build_prefill_chunk(size))
+        span = (self.tracer.span(SPAN_PREFILL_CHUNK, category="serve",
+                                 slot=slot, size=size, offset=start,
+                                 length=length)
+                if self.tracer else _null_span())
+        with span:
+            first, self._cache = fn(self._params, self._cache,
+                                    jnp.asarray(padded), jnp.int32(start),
+                                    jnp.int32(used), jnp.int32(slot),
+                                    self._next_key())
+            if final:
+                first = int(first)
+        if not final:
+            return start + used, None
+        self._tokens = self._tokens.at[slot].set(first)
+        self._positions = self._positions.at[slot].set(length)
+        self._active = self._active.at[slot].set(True)
+        self._positions_host[slot] = length
+        self._active_host[slot] = True
+        return start + used, first
 
     def decode(self) -> np.ndarray:
         """One [S, 1] decode step over every slot; returns the [S] next
         tokens (pad_token on inactive slots). Always the same compiled
         executable, whatever the live mix."""
-        fn = self.compile_cache.get(("decode", self.slots),
+        fn = self.compile_cache.get(self._key("decode", self.slots),
                                     self._build_decode)
         span = (self.tracer.span(SPAN_DECODE, category="serve",
                                  live=self.allocator.live_count)
@@ -299,7 +543,64 @@ class DecodeEngine:
         self._tokens = tokens
         self._positions = self._positions + self._active.astype(
             self._positions.dtype)
+        self._positions_host += self._active_host
         return out
+
+    def decode_speculative(self, drafts: np.ndarray
+                           ) -> tp.Tuple[np.ndarray, np.ndarray]:
+        """One `[S, k+1]` verify step over every slot against `drafts`
+        ([S, k] proposed tokens; inactive rows ignored).
+
+        Returns `(out_tokens, accepted)`: out_tokens [S, k+1] holds
+        each live slot's emitted tokens at indices 0..accepted[s]
+        (accepted drafts + the bonus/resampled token, `pad_token`
+        beyond — and everywhere on inactive rows); accepted [S] counts
+        kept drafts. Greedy engines emit exactly `generate()`'s
+        tokens; see `models.decoding.speculative_acceptance`. Rollback
+        after rejection is free: the step advances each slot's
+        position by accepted+1, and the stale draft K/V rows beyond it
+        are past every causal horizon until overwritten.
+        """
+        import jax.numpy as jnp
+        drafts = np.asarray(drafts, np.int32)
+        if drafts.ndim != 2 or drafts.shape[0] != self.slots \
+                or drafts.shape[1] < 1:
+            raise ValueError(f"drafts must be [S={self.slots}, k>=1], "
+                             f"got {drafts.shape}")
+        k = int(drafts.shape[1])
+        fn = self.compile_cache.get(self._key("verify", self.slots, k),
+                                    lambda: self._build_verify(k))
+        span = (self.tracer.span(SPAN_VERIFY, category="serve", k=k,
+                                 live=self.allocator.live_count)
+                if self.tracer else _null_span())
+        with span:
+            out, accepted, self._tokens, self._positions, self._cache = fn(
+                self._params, self._cache, self._tokens, jnp.asarray(drafts),
+                self._positions, self._active, self._next_key())
+            out_np = np.asarray(out)
+            accepted_np = np.asarray(accepted)
+        self._positions_host += np.where(self._active_host,
+                                         accepted_np.astype(np.int64) + 1, 0)
+        return out_np, accepted_np
+
+    def set_slot_state(self, slot: int, last_token: int,
+                       position: int) -> None:
+        """Overwrite a live slot's (last token, position) pair.
+
+        This IS speculative rollback/resync for a mirrored engine: a
+        draft engine that ran ahead k tokens resets to the verified
+        position + bonus token here, and the stale K/V rows beyond
+        `position` need no cleanup (beyond every causal horizon until
+        overwritten). Also the test hook for forcing cache states.
+        """
+        if slot not in self.allocator.live:
+            raise ValueError(f"slot {slot} is not live")
+        if not 0 <= position <= self.max_seq_len:
+            raise ValueError(f"position {position} outside "
+                             f"[0, {self.max_seq_len}]")
+        self._tokens = self._tokens.at[slot].set(int(last_token))
+        self._positions = self._positions.at[slot].set(int(position))
+        self._positions_host[slot] = int(position)
 
     def retire(self, slot: int) -> None:
         """Free `slot`: deactivate it and park its position out of range
@@ -307,11 +608,17 @@ class DecodeEngine:
         self._active = self._active.at[slot].set(False)
         self._positions = self._positions.at[slot].set(self.max_seq_len)
         self._tokens = self._tokens.at[slot].set(self.pad_token)
+        self._positions_host[slot] = self.max_seq_len
+        self._active_host[slot] = False
         self.allocator.release(slot)
 
     def slot_length(self, slot: int) -> int:
-        """Current sequence length of a live slot (prompt + generated)."""
-        return int(self._positions[slot])
+        """Current sequence length of a live slot (prompt + generated).
+
+        Served from the host position snapshot — no device->host sync,
+        so the scheduler can call it per live slot per step for free.
+        """
+        return int(self._positions_host[slot])
 
     @property
     def live_count(self) -> int:

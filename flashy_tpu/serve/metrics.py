@@ -1,13 +1,15 @@
 # Serving metrics. The numbers an operator actually pages on: how long
 # until a request's first token (TTFT — queue wait + prefill), how fast
 # tokens stream after that (inter-token latency), how deep the admission
-# queue is running and how full the slot pool is. Collected as raw
-# samples host-side (cheap appends), summarized as p50/p95 on demand,
-# fanned out through the PR 1 Tracer (live Perfetto counter tracks +
-# telemetry.jsonl records) and through ResultLogger to every experiment
-# logging backend, and snapshotted to `serve.json` in the XP folder for
-# `python -m flashy_tpu.info`.
-"""ServeMetrics: TTFT / inter-token latency / queue depth / occupancy."""
+# queue is running, how full the slot pool is, and — under speculative
+# decoding — whether the draft is earning its verify step (acceptance
+# rate, drafted-vs-emitted, per-step accepted-token distribution).
+# Collected as raw samples host-side (cheap appends), summarized as
+# p50/p95 on demand, fanned out through the PR 1 Tracer (live Perfetto
+# counter tracks + telemetry.jsonl records) and through ResultLogger to
+# every experiment logging backend, and snapshotted to `serve.json` in
+# the XP folder for `python -m flashy_tpu.info`.
+"""ServeMetrics: TTFT / ITL / queue depth / occupancy / acceptance."""
 import json
 import typing as tp
 from pathlib import Path
@@ -19,6 +21,7 @@ from ..xp import SERVE_STATUS_NAME, AnyPath
 # Perfetto counter-track kinds for the serving path.
 COUNTER_QUEUE = "serve/queue_depth"
 COUNTER_OCCUPANCY = "serve/slot_occupancy"
+COUNTER_ACCEPTANCE = "serve/acceptance"
 
 
 class ServeMetrics:
@@ -44,6 +47,12 @@ class ServeMetrics:
         self.latency: tp.List[float] = []
         self.queue_depth: tp.List[int] = []
         self.occupancy: tp.List[float] = []
+        # speculative decoding: proposal/acceptance accounting
+        self.spec_steps = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        self.accepted_per_step: tp.List[int] = []
 
     # ------------------------------------------------------------------
     # scheduler hooks
@@ -72,6 +81,23 @@ class ServeMetrics:
         self.completed += 1
         self.latency.append(latency_seconds)
         self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
+
+    def on_spec_step(self, drafted: int, accepted: tp.Sequence[int],
+                     emitted: int) -> None:
+        """One speculative verify step: `drafted` tokens proposed per
+        live slot, `accepted` kept-draft counts per live slot, and
+        `emitted` tokens actually delivered (accepted + bonus, minus
+        any EOS/budget truncation)."""
+        live = len(accepted)
+        self.spec_steps += 1
+        self.spec_drafted += drafted * live
+        self.spec_accepted += int(sum(accepted))
+        self.spec_emitted += emitted
+        self.accepted_per_step.extend(int(a) for a in accepted)
+        if self.tracer is not None and self.spec_drafted:
+            self.tracer.counter(
+                COUNTER_ACCEPTANCE,
+                rate=self.spec_accepted / self.spec_drafted)
 
     def on_gauges(self, queue_depth: int, live: int, capacity: int) -> None:
         """Sample the queue depth + slot occupancy (once per step)."""
@@ -102,6 +128,15 @@ class ServeMetrics:
                                      ("occupancy", self.occupancy, 1)):
             out[f"{name}_p50"] = percentile(samples, 50) * scale
             out[f"{name}_p95"] = percentile(samples, 95) * scale
+        if self.spec_steps:
+            out["spec_drafted"] = self.spec_drafted
+            out["spec_emitted"] = self.spec_emitted
+            out["acceptance_rate"] = (self.spec_accepted / self.spec_drafted
+                                      if self.spec_drafted else 0.0)
+            out["accepted_per_step_p50"] = percentile(
+                self.accepted_per_step, 50)
+            out["accepted_per_step_p95"] = percentile(
+                self.accepted_per_step, 95)
         for reason, count in sorted(self.finish_reasons.items()):
             out[f"finish_{reason}"] = count
         return out

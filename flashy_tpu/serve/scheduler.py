@@ -35,10 +35,13 @@ class QueueFull(RuntimeError):
 class Request:
     """One generation request and its lifecycle record.
 
-    States: queued -> running -> done. `generated` grows one token per
-    engine step; `output` is prompt + generated (the EOS, when one
-    fired, is included — it is the terminator the model actually
-    emitted, matching `generate(eos_token=...)`).
+    States: queued -> (prefilling ->) running -> done ('prefilling'
+    only exists on chunked-prefill engines, where a slot is occupied
+    for several ticks before the first token). `generated` grows one
+    token per engine step — or up to `k+1` per step under speculative
+    decoding; `output` is prompt + generated (the EOS, when one fired,
+    is included — it is the terminator the model actually emitted,
+    matching `generate(eos_token=...)`).
     """
     uid: int
     prompt: np.ndarray
@@ -73,23 +76,60 @@ class ContinuousBatchingScheduler:
     batch boundary — capacity freed mid-stream is refilled on the next
     step while the other slots keep generating.
 
+    On a chunked-prefill engine (`DecodeEngine(chunk=...)`) admission
+    assigns the slot immediately but the prompt is prefilled in fixed
+    `chunk` slices, at most `prefill_chunks_per_step` slices per
+    `step()` — so a long prompt never monopolizes a step, and the
+    inter-token stall it can impose on live slots is bounded by one
+    chunk's compute instead of one full bucket's.
+
+    With a `draft` provider attached, each step verifies the draft's k
+    proposed tokens per slot in ONE `[S, k+1]` engine call and emits
+    `accepted + 1` tokens per live slot (see serve/draft.py); greedy
+    output is token-exact vs `generate()` whatever the draft proposes.
+
     Args:
         engine: the DecodeEngine supplying slots and compiled steps.
         max_queue: admission-queue depth; `submit()` past it raises
             QueueFull (backpressure).
         metrics: a ServeMetrics; one is created (sharing the engine's
             tracer) when not given.
+        draft: optional DraftProvider enabling speculative decoding.
+            Its `k` must match `engine.spec_k` when that is set (the
+            warm-up covered exactly that verify shape).
+        prefill_chunks_per_step: chunked-prefill slices advanced per
+            scheduler step (the prefill/decode interleave ratio).
     """
 
     def __init__(self, engine: DecodeEngine, max_queue: int = 128,
-                 metrics: tp.Optional[ServeMetrics] = None):
+                 metrics: tp.Optional[ServeMetrics] = None,
+                 draft: tp.Optional[tp.Any] = None,
+                 prefill_chunks_per_step: int = 1):
         self.engine = engine
         self.max_queue = max_queue
         self.metrics = metrics or ServeMetrics(tracer=engine.tracer)
+        self.draft = draft
+        if draft is not None and engine.spec_k is not None \
+                and draft.k != engine.spec_k:
+            raise ValueError(
+                f"draft proposes k={draft.k} tokens but the engine "
+                f"warmed its verify step for spec_k={engine.spec_k}; "
+                f"a mismatch would compile post-warm-up")
+        if prefill_chunks_per_step < 1:
+            raise ValueError(f"prefill_chunks_per_step must be >= 1, "
+                             f"got {prefill_chunks_per_step}")
+        self.prefill_chunks_per_step = prefill_chunks_per_step
         self._queue: tp.Deque[Request] = collections.deque()
         self._running: tp.Dict[int, Request] = {}  # slot -> request
+        # slot -> [request, next chunk start]; insertion order == FIFO
+        self._prefilling: tp.Dict[int, tp.List[tp.Any]] = {}
+        self._draft_slots: tp.Set[int] = set()  # slots the draft tracks
         self._uid = itertools.count()
         self.admitted_order: tp.List[int] = []  # uids, admission sequence
+        # prompt tokens prefilled in the latest step / the max over the
+        # run — the demo asserts max <= chunk (the stall bound).
+        self.prefill_tokens_last_step = 0
+        self.max_prefill_tokens_per_step = 0
 
     # ------------------------------------------------------------------
     # admission
@@ -104,7 +144,8 @@ class ContinuousBatchingScheduler:
 
     @property
     def idle(self) -> bool:
-        return not self._queue and not self._running
+        return (not self._queue and not self._running
+                and not self._prefilling)
 
     def submit(self, prompt: tp.Any, max_new_tokens: int,
                eos_token: tp.Optional[int] = None,
@@ -178,8 +219,34 @@ class ContinuousBatchingScheduler:
         self._queue = kept
         return shed
 
+    def _first_token(self, slot: int, request: Request,
+                     first: int) -> None:
+        """Prefill completed: record TTFT, seed the draft, and either
+        retire the request (EOS / budget of 1) or start decoding it."""
+        now = time.perf_counter()
+        request.state = "running"
+        request.first_token_at = now
+        request.generated.append(first)
+        self.metrics.on_first_token(now - request.submitted_at)
+        if request.eos_token is not None and first == request.eos_token:
+            self._finish(request, "eos")
+        elif len(request.generated) >= request.max_new_tokens:
+            self._finish(request, "length")
+        else:
+            self._running[slot] = request
+            if self.draft is not None:
+                self.draft.begin(slot, request.prompt, first)
+                self._draft_slots.add(slot)
+
     def _admit(self) -> int:
-        """Prefill queued requests into free slots; returns #admitted."""
+        """Assign queued requests to free slots and advance prefill;
+        returns #admitted (slots assigned this step).
+
+        Monolithic engines prefill the whole (bucketed) prompt at
+        assignment; chunked engines advance at most
+        `prefill_chunks_per_step` slices per step across the
+        in-progress prefills, oldest first (FIFO down to the tick).
+        """
         admitted = 0
         while self._queue and self.engine.free_count:
             request = self._queue.popleft()
@@ -194,21 +261,33 @@ class ContinuousBatchingScheduler:
                 continue
             slot = self.engine.acquire_slot()
             assert slot is not None
-            first = self.engine.prefill(slot, request.prompt)
-            now = time.perf_counter()
-            request.state = "running"
             request.slot = slot
-            request.first_token_at = now
-            request.generated.append(first)
             self.admitted_order.append(request.uid)
-            self.metrics.on_first_token(now - request.submitted_at)
             admitted += 1
-            if (request.eos_token is not None and first == request.eos_token):
-                self._finish(request, "eos")
-            elif len(request.generated) >= request.max_new_tokens:
-                self._finish(request, "length")
+            if self.engine.chunk is None:
+                first = self.engine.prefill(slot, request.prompt)
+                self._first_token(slot, request, first)
             else:
-                self._running[slot] = request
+                request.state = "prefilling"
+                self._prefilling[slot] = [request, 0]
+        # advance chunked prefills, bounded per step (the stall bound)
+        self.prefill_tokens_last_step = 0
+        budget = self.prefill_chunks_per_step
+        for slot in list(self._prefilling):
+            if budget <= 0:
+                break
+            request, start = self._prefilling[slot]
+            new_start, first = self.engine.prefill_chunk(
+                slot, request.prompt, start)
+            budget -= 1
+            self.prefill_tokens_last_step += new_start - start
+            if first is None:
+                self._prefilling[slot][1] = new_start
+            else:
+                del self._prefilling[slot]
+                self._first_token(slot, request, first)
+        self.max_prefill_tokens_per_step = max(
+            self.max_prefill_tokens_per_step, self.prefill_tokens_last_step)
         return admitted
 
     # ------------------------------------------------------------------
@@ -219,15 +298,41 @@ class ContinuousBatchingScheduler:
         request.finish_reason = reason
         request.finished_at = time.perf_counter()
         self.engine.retire(request.slot)
+        if request.slot in self._draft_slots:
+            self._draft_slots.discard(request.slot)
+            self.draft.retire(request.slot)
         self.metrics.on_done(request.finished_at - request.submitted_at,
                              reason)
         logger.debug("request %d done (%s): %d prompt + %d generated",
                      request.uid, reason, request.prompt.size,
                      len(request.generated))
 
+    def _feed(self, slot: int, request: Request, tokens: tp.Sequence[int],
+              gap: float) -> tp.Tuple[int, bool]:
+        """Append emitted tokens to a running request, stopping at EOS
+        or the length budget; returns (#kept, finished). The first
+        token of the batch carries the step's latency as its ITL, the
+        rest arrive in the same burst (ITL 0) — literal inter-token
+        arrival times, so spec-on p95 still reflects step cost."""
+        kept = 0
+        for token in tokens:
+            token = int(token)
+            request.generated.append(token)
+            kept += 1
+            self.metrics.on_token(gap if kept == 1 else 0.0)
+            if request.eos_token is not None and token == request.eos_token:
+                del self._running[slot]
+                self._finish(request, "eos")
+                return kept, True
+            if len(request.generated) >= request.max_new_tokens:
+                del self._running[slot]
+                self._finish(request, "length")
+                return kept, True
+        return kept, False
+
     def step(self) -> int:
-        """Shed expired + admit + one decode step + retire; returns
-        #tokens emitted."""
+        """Shed expired + admit/advance prefill + one decode (or
+        speculative verify) step + retire; returns #tokens emitted."""
         self._shed_expired()
         self._admit()
         self.metrics.on_gauges(queue_depth=len(self._queue),
@@ -236,20 +341,35 @@ class ContinuousBatchingScheduler:
         if not self._running:
             return 0
         step_start = time.perf_counter()
-        tokens = self.engine.decode()
+        if self.draft is None:
+            tokens = self.engine.decode()
+            gap = time.perf_counter() - step_start
+            emitted = 0
+            for slot, request in list(self._running.items()):
+                kept, _ = self._feed(slot, request, [int(tokens[slot])], gap)
+                emitted += kept
+            return emitted
+
+        # speculative step: k drafted tokens per slot verified in ONE
+        # [S, k+1] call; each live slot emits accepted+1 tokens (EOS /
+        # budget may truncate the span — the engine slot is retired
+        # then, so the overshoot never lands anywhere).
+        drafts = self.draft.propose()
+        out, accepted = self.engine.decode_speculative(drafts)
         gap = time.perf_counter() - step_start
         emitted = 0
+        accepted_counts: tp.List[int] = []
         for slot, request in list(self._running.items()):
-            token = int(tokens[slot])
-            request.generated.append(token)
-            emitted += 1
-            self.metrics.on_token(gap)
-            if request.eos_token is not None and token == request.eos_token:
-                del self._running[slot]
-                self._finish(request, "eos")
-            elif len(request.generated) >= request.max_new_tokens:
-                del self._running[slot]
-                self._finish(request, "length")
+            span = out[slot, :int(accepted[slot]) + 1]
+            accepted_counts.append(int(accepted[slot]))
+            kept, finished = self._feed(slot, request, span, gap)
+            emitted += kept
+            if not finished:
+                self.draft.observe(slot, span[:kept],
+                                   self.engine.slot_length(slot))
+        self.metrics.on_spec_step(drafted=int(drafts.shape[1]),
+                                  accepted=accepted_counts,
+                                  emitted=emitted)
         return emitted
 
     def run(self, max_steps: int = 1_000_000) -> None:

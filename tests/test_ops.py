@@ -230,6 +230,32 @@ def test_tune_flash_blocks_cpu_returns_default():
     assert tune_flash_blocks(1, 256, 2, 16) == (256, 256)
 
 
+def test_tune_cache_key_pins_runtime_and_device():
+    # A persisted block-size winner is a measurement of one compiled
+    # kernel on one chip generation: the cache key must pin the
+    # jax/jaxlib versions AND device_kind so a runtime upgrade (or a
+    # cache file shared across heterogeneous fleets) can never replay
+    # a stale winner — and it must be STABLE across calls, or the
+    # cache would never hit.
+    import jax
+    import jax.numpy as jnp
+
+    import flashy_tpu.ops.tuning as tuning
+
+    key = tuning._make_key(1, 256, 2, 16, True, jnp.bfloat16, True)
+    assert key == tuning._make_key(1, 256, 2, 16, True, jnp.bfloat16, True)
+    assert f"jax-{jax.__version__}" in key
+    assert any(str(part).startswith("jaxlib-") for part in key)
+    assert jax.devices()[0].device_kind in key
+    # every shape/config argument still participates
+    assert key != tuning._make_key(2, 256, 2, 16, True, jnp.bfloat16, True)
+    assert key != tuning._make_key(1, 256, 2, 16, False, jnp.bfloat16, True)
+    assert key != tuning._make_key(1, 256, 2, 16, True, jnp.float32, True)
+    # the disk spelling round-trips through one json cache entry
+    disk_key = "/".join(str(part) for part in key)
+    assert disk_key.count("jax-") >= 1 and "jaxlib-" in disk_key
+
+
 def test_flash_auto_block_for_384():
     # 384 = 3*128 divides none of the default blocks; the auto-pick must
     # run the kernel at 384 instead of falling back to dense, and a
