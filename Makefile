@@ -31,7 +31,8 @@ bench:
 # (exit 1 on any violation). A couple of minutes; also run by the
 # tests workflow.
 serve-demo:
-	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --requests 32 --slots 8
+	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --requests 32 --slots 8 \
+		--legs batching,speculative,chunked
 
 # Speculative decoding + chunked prefill gate on CPU: a repetitive
 # mixed-length workload through a chunked-prefill engine with the
@@ -43,6 +44,18 @@ serve-demo:
 # tests workflow.
 serve-spec-demo:
 	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --legs speculative,chunked
+
+# Paged-KV-cache gate on CPU: an int8 block pool sized to the dense
+# cache budget of 4 slots must serve 16 concurrent slots (>= 2x is the
+# floor) over a staggered workload sharing a long system prompt —
+# token-exact vs per-request generate(), prefix-hit-rate over its
+# floor, at least one copy-on-write fork, the pool conservation
+# invariant held (never over-committed), and zero post-warm-up
+# compiles across admission/prefix-hit/COW/decode/speculative
+# verify/retirement (exit 1 on any violation). Seconds; also run by
+# the tests workflow.
+serve-paged-demo:
+	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --legs paged
 
 # Fault-tolerance chaos drill on CPU: train with an injected transient
 # IO fault (must be absorbed by retry), a simulated mid-stage SIGTERM
@@ -81,4 +94,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all coverage bench serve-demo serve-spec-demo chaos-demo zero-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo zero-demo datapipe-demo docs native dist

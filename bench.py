@@ -791,6 +791,107 @@ def bench_decode(jax, on_tpu: bool):
     except Exception as exc:  # noqa: BLE001  (serve leg is additive)
         log(f"decode speculative sub-leg skipped: {exc}")
         result["spec_error"] = str(exc)[:200]
+
+    # --- paged KV cache: paged+int8 vs dense through the slot engine
+    # at EQUAL batch (the tok/s parity check), plus the capacity story:
+    # bytes reserved per slot and how many concurrent requests of this
+    # workload fit the dense layout's HBM budget under each layout.
+    # Workload: a shared system prompt + per-request tails — the
+    # prefix-cache regime (system prompts, few-shot headers) paging
+    # exists for.
+    try:
+        from flashy_tpu.ops.paged_attention import block_bytes
+        from flashy_tpu.serve import (ContinuousBatchingScheduler,
+                                      DecodeEngine)
+
+        slots = batch
+        block_size = 16 if on_tpu else 8
+        sys_len = 2 * block_size + block_size // 2  # partial block: COW
+        # decode long enough that the timed steady-state window (all
+        # slots live, pure decode) dominates timer noise
+        paged_new = cfg.max_seq_len - sys_len - block_size
+        corpus_rng = np.random.default_rng(11)
+        system = corpus_rng.integers(0, vocab, sys_len).astype(np.int32)
+        paged_workload = []
+        for _ in range(slots * 4):
+            tail = corpus_rng.integers(
+                0, vocab, int(corpus_rng.integers(2, block_size))
+            ).astype(np.int32)
+            paged_workload.append((np.concatenate([system, tail]),
+                                   paged_new))
+
+        def paged_serve_run(layout: str):
+            # the parity claim is DECODE throughput at equal batch, so
+            # the timed window starts once every slot is live (prefill
+            # differs by construction: one bucketed call dense vs
+            # `prompt/chunk` chunk calls paged — a TTFT trade, not a
+            # steady-state cost) and ends at the synchronized
+            # retirement; a second, untimed wave then measures the
+            # capacity/prefix story under slot turnover.
+            engine = DecodeEngine(
+                model, params, slots=slots, max_seq_len=cfg.max_seq_len,
+                cache_layout=layout, block_size=block_size,
+                kv_dtype="int8" if layout == "paged" else "model",
+                cache_scope=f"bench_{layout}")
+            engine.warmup(
+                prompt_lengths=[len(p) for p, _ in paged_workload])
+            scheduler = ContinuousBatchingScheduler(
+                engine, max_queue=len(paged_workload))
+            best = 0.0
+            for wave in range(3):  # best-of-3 synchronized waves
+                handles = [scheduler.submit(p, m)
+                           for p, m in paged_workload[:slots]]
+                while any(h.state in ("queued", "prefilling")
+                          for h in handles):
+                    scheduler.step()
+                decoded = sum(len(h.generated) for h in handles)
+                begin = time.perf_counter()
+                scheduler.run()
+                wall = time.perf_counter() - begin
+                tokens = sum(len(h.generated) for h in handles) - decoded
+                best = max(best, tokens / wall)
+            for p, m in paged_workload[slots:]:  # capacity wave, untimed
+                scheduler.submit(p, m)
+            scheduler.run()
+            assert engine.compile_cache.stats()["recompiles"] == 0
+            return (best / len(jax.devices()), engine,
+                    scheduler.metrics.summary())
+
+        dense_tok_s, dense_eng, _ = paged_serve_run("dense")
+        paged_tok_s, paged_eng, paged_summary = paged_serve_run("paged")
+        per_block = block_bytes(cfg, block_size, "int8")
+        pool = paged_eng.pool_stats()
+        budget = dense_eng.cache_bytes()
+        dense_per_slot = budget / slots
+        # average private (non-shared) blocks one request of this
+        # workload costs — the marginal HBM price of one more slot
+        # (3 parity waves of `slots` + the capacity wave all allocated)
+        admissions = 3 * slots + len(paged_workload) - slots
+        fresh_per_req = pool["allocated_total"] / admissions
+        paged_per_slot = fresh_per_req * per_block
+        result.update({
+            "paged_tokens_per_sec_per_chip": round(paged_tok_s, 1),
+            "paged_vs_dense": round(paged_tok_s / dense_tok_s, 3),
+            "kv_bytes_per_slot_dense": int(dense_per_slot),
+            "kv_bytes_per_slot": int(paged_per_slot),
+            "max_concurrent_slots_at_fixed_hbm": int(
+                budget // max(paged_per_slot, 1)),
+            "max_concurrent_slots_at_fixed_hbm_dense": slots,
+            "prefix_hit_rate": round(
+                paged_summary.get("prefix_hit_rate", 0.0), 3),
+            "paged_block_size": block_size,
+            "paged_cow_forks": int(pool["cow_forks"]),
+        })
+        log(f"decode paged: {dense_tok_s:.0f} (dense) -> "
+            f"{paged_tok_s:.0f} (paged int8) tok/s/chip "
+            f"({paged_tok_s / dense_tok_s:.2f}x at equal batch), "
+            f"{dense_per_slot / 1024:.0f} -> {paged_per_slot / 1024:.0f} "
+            f"KiB/slot, {result['max_concurrent_slots_at_fixed_hbm']} "
+            f"slots at the dense {slots}-slot budget, prefix hit "
+            f"{result['prefix_hit_rate'] * 100:.0f}%")
+    except Exception as exc:  # noqa: BLE001  (serve leg is additive)
+        log(f"decode paged sub-leg skipped: {exc}")
+        result["paged_error"] = str(exc)[:200]
     return result
 
 
@@ -1087,7 +1188,10 @@ _COMPACT_KEYS = {
     "datapipe": ("tokens_per_sec", "packing_efficiency"),
     "gan": ("steps_per_sec",),
     "decode": ("tokens_per_sec_per_chip", "spec_tokens_per_sec_per_chip",
-               "spec_speedup", "acceptance_rate", "itl_ms_p95"),
+               "spec_speedup", "acceptance_rate", "itl_ms_p95",
+               "paged_tokens_per_sec_per_chip", "paged_vs_dense",
+               "kv_bytes_per_slot", "max_concurrent_slots_at_fixed_hbm",
+               "prefix_hit_rate"),
     "host_sync": ("gib_per_sec",),
     "all_reduce": ("bus_bandwidth_gb_s",),
 }

@@ -97,6 +97,20 @@ def format_serve_status(status: dict) -> str:
         if "accepted_per_step_p50" in status:
             parts.append("accepted_per_step_p50="
                          f"{status['accepted_per_step_p50']:.1f}")
+    # paged KV cache: layout + K/V dtype, block-pool occupancy and the
+    # prefix-cache hit rate (prompt tokens served by refcount bump /
+    # COW fork instead of prefill)
+    if status.get("cache_layout"):
+        layout = str(status["cache_layout"])
+        if status.get("kv_dtype"):
+            layout += f"/{status['kv_dtype']}"
+        parts.append(f"cache={layout}")
+    if "pool_occupancy_p50" in status:
+        parts.append(f"pool_p50={status['pool_occupancy_p50'] * 100:.0f}%")
+    if "pool_occupancy_p95" in status:
+        parts.append(f"pool_p95={status['pool_occupancy_p95'] * 100:.0f}%")
+    if "prefix_hit_rate" in status:
+        parts.append(f"prefix_hit={status['prefix_hit_rate'] * 100:.0f}%")
     return "  ".join(parts) or "(empty serve.json)"
 
 

@@ -239,6 +239,39 @@ def _layer_forward(cfg: TransformerConfig, bp: tp.Dict, x: jax.Array,
     return x, k_cache, v_cache
 
 
+def _embed_tokens(p: tp.Dict, tokens: jax.Array, dtype) -> jax.Array:
+    """Token ids [B, S] -> embeddings [B, S, D] (int8 tables supported).
+
+    Shared by the dense `_apply_step` and the paged serving step
+    (serve/paged.py) — one copy of the quantized-row-gather rule.
+    """
+    if is_quantized(p["embed"]):
+        # Row gather stays int8 (tiny); dequantize only the gathered rows.
+        return (jnp.take(p["embed"]["q"], tokens, axis=0).astype(dtype)
+                * jnp.take(p["embed"]["scale"], tokens, axis=0).astype(dtype))
+    return jnp.take(p["embed"], tokens, axis=0).astype(dtype)
+
+
+def _head_logits(p: tp.Dict, x: jax.Array, cfg: TransformerConfig
+                 ) -> jax.Array:
+    """Final norm + tied LM head: [B, S, D] -> f32 logits [B, S, V].
+
+    Head operands in the compute dtype + f32 accumulation — must match
+    TransformerLM.__call__'s head exactly (the decode-vs-uncached-
+    forward equality tests compare these logits). The quantized head's
+    per-vocab-row scale applies to the f32 logits. Shared by the dense
+    and paged apply steps.
+    """
+    x = _rmsnorm(x, p["norm_f"]["scale"], cfg.dtype)
+    if is_quantized(p["embed"]):
+        logits = jnp.einsum("btd,vd->btv", x,
+                            p["embed"]["q"].astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits * p["embed"]["scale"][:, 0]
+    return jnp.einsum("btd,vd->btv", x, p["embed"].astype(cfg.dtype),
+                      preferred_element_type=jnp.float32)
+
+
 def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
                 positions: jax.Array, cache: tp.Dict, cache_index: jax.Array):
     """Forward `tokens` [B, S] at `positions`, reading+writing the cache.
@@ -250,13 +283,7 @@ def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
     stacked params + stacked cache.
     """
     p = params["params"]
-    if is_quantized(p["embed"]):
-        # Row gather stays int8 (tiny); dequantize only the gathered rows.
-        x = (jnp.take(p["embed"]["q"], tokens, axis=0).astype(cfg.dtype)
-             * jnp.take(p["embed"]["scale"], tokens,
-                        axis=0).astype(cfg.dtype))
-    else:
-        x = jnp.take(p["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = _embed_tokens(p, tokens, cfg.dtype)
     if cfg.scan_layers:
         stacked = p["blocks"]["block"]  # every leaf has leading [L]
 
@@ -278,21 +305,7 @@ def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
                 cache[name]["k"], cache[name]["v"], cache_index)
             new_cache[name] = {"k": k_cache, "v": v_cache}
 
-    x = _rmsnorm(x, p["norm_f"]["scale"], cfg.dtype)
-    # Head operands in the compute dtype + f32 accumulation — must
-    # match TransformerLM.__call__'s head exactly (the decode-vs-
-    # uncached-forward equality tests compare these logits). The
-    # quantized head's per-vocab-row scale applies to the f32 logits.
-    if is_quantized(p["embed"]):
-        logits = jnp.einsum("btd,vd->btv", x,
-                            p["embed"]["q"].astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
-        logits = logits * p["embed"]["scale"][:, 0]
-    else:
-        logits = jnp.einsum("btd,vd->btv", x,
-                            p["embed"].astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
-    return logits, new_cache
+    return _head_logits(p, x, cfg), new_cache
 
 
 def speculative_acceptance(draft_tokens: jax.Array, logits: jax.Array, *,

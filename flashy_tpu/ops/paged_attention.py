@@ -1,0 +1,243 @@
+# Paged KV attention — the block-pool counterpart of the dense slab
+# reads/writes in models/decoding.py. The dense serving cache reserves
+# every slot's worst case ([S, max_seq_len]) up front, so HBM — not the
+# MXU — caps concurrency. Here K and V live in one global pool of
+# fixed-size blocks `[num_blocks, block_size, heads, head_dim]`, and
+# each slot owns a per-slot BLOCK TABLE `[max_blocks]` of pool
+# indices: logical position p of a slot maps to physical row
+# `(table[p // block_size], p % block_size)`. Liveness, table contents
+# and positions are all INPUTS — never shapes — so the ONE-executable-
+# per-shape serving invariant survives: the same compiled decode/verify
+# step runs whatever mix of slots, tables and shared blocks is live.
+#
+# Two proofs carry over from the dense path:
+#  * Sentinel right-padding: physical block 0 is reserved as the
+#    sentinel; unassigned table entries point at it. A sentinel
+#    entry at table index j
+#    covers logical positions [j*bs, (j+1)*bs), all beyond the slot's
+#    causal horizon until a real block replaces it — so its content is
+#    never attended, exactly the dense right-padding proof. Writes from
+#    parked slots (position == max_seq_len) and verify-overshoot rows
+#    redirect to the sentinel (a where on the block id, not a wider
+#    table) and are garbage-by-design, the paged spelling of
+#    mode="drop".
+#  * Purity of K/V rows: a cached row is a pure function of
+#    (token, position, params) — no dependence on neighbouring tokens —
+#    which is what makes cross-request prefix sharing and partial-block
+#    copy-on-write forks exact (serve/paged.py).
+#
+# Reads are gather-based: each slot gathers its table's blocks into a
+# logical [max_len] view and attends it under the ordinary causal
+# mask. XLA lowers the gather + (optional int8 dequant) into the
+# attention operand read; nothing dense is materialized per step beyond
+# the gathered keys the dense path would read anyway — the logical view
+# is exactly the dense slab's size.
+"""Gather-based paged attention over a block-pool KV cache."""
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+
+from ..models.quantize import dequantize_kv, quantize_kv
+
+# Physical block 0 is the sentinel: never allocated by the serve-side
+# BlockPool, target of every out-of-coverage write, content never
+# attended (sentinel table entries only cover logical positions beyond
+# the causal horizon).
+SENTINEL_BLOCK = 0
+
+
+def pool_spec(num_blocks: int, block_size: int, num_heads: int,
+              head_dim: int, dtype, kv_dtype: str
+              ) -> tp.Dict[str, tp.Tuple[tp.Tuple[int, ...], tp.Any]]:
+    """Leaf name -> (shape, dtype) of ONE layer's pool entry.
+
+    `kv_dtype='int8'` stores int8 payloads plus per-(row, head) f32
+    scales beside them (models/quantize.quantize_kv); any other value
+    stores dense K/V in the model's compute dtype.
+    """
+    shape = (num_blocks, block_size, num_heads, head_dim)
+    if kv_dtype == "int8":
+        return {"k": (shape, jnp.int8), "v": (shape, jnp.int8),
+                "k_scale": (shape[:-1], jnp.float32),
+                "v_scale": (shape[:-1], jnp.float32)}
+    return {"k": (shape, dtype), "v": (shape, dtype)}
+
+
+def init_pool(cfg, num_blocks: int, block_size: int,
+              kv_dtype: str = "model") -> tp.Dict:
+    """Allocate the block-pool cache pytree for a TransformerLM config.
+
+    Mirrors models/decoding.init_cache's structure so the rest of the
+    decode step is layout-agnostic: per-layer models get one entry per
+    block_i; scan-stacked models get stacked [L, N, bs, H, Dh] leaves
+    scanned together with the stacked parameters. Block 0 is the
+    sentinel (ops-level convention; serve/paged.BlockPool never hands
+    it out).
+    """
+    spec = pool_spec(num_blocks, block_size, cfg.num_heads, cfg.head_dim,
+                     cfg.dtype, kv_dtype)
+    if cfg.scan_layers:
+        return {name: jnp.zeros((cfg.num_layers,) + shape, dt)
+                for name, (shape, dt) in spec.items()}
+    return {f"block_{i}": {name: jnp.zeros(shape, dt)
+                           for name, (shape, dt) in spec.items()}
+            for i in range(cfg.num_layers)}
+
+
+def _physical(table: jax.Array, positions: jax.Array, block_size: int
+              ) -> tp.Tuple[jax.Array, jax.Array]:
+    """Logical positions [B, T] -> (pool block [B, T], offset [B, T]).
+
+    Positions past the table's coverage (a parked slot at max_seq_len,
+    a verify overshoot row) redirect to the SENTINEL block — not a
+    clamp onto a real block's final row, which would corrupt the last
+    genuinely-written position. This is the paged spelling of the
+    dense path's mode="drop", as data instead of as an extra table
+    column (a wider table would make every attention gather read
+    block_size more keys than the dense layout for nothing).
+    """
+    index = positions // block_size
+    block = jnp.take_along_axis(
+        table, jnp.minimum(index, table.shape[-1] - 1), axis=-1)
+    block = jnp.where(index >= table.shape[-1], SENTINEL_BLOCK, block)
+    return block, positions % block_size
+
+
+def paged_write(entry: tp.Dict, new_k: jax.Array, new_v: jax.Array,
+                table: jax.Array, positions: jax.Array) -> tp.Dict:
+    """Write fresh K/V rows `[B, T, H, Dh]` through the block tables.
+
+    `entry` is one layer's pool dict ({k, v} or {k, v, k_scale,
+    v_scale}); `table` is [B, max_blocks]; `positions` [B, T] are
+    the rows' ABSOLUTE positions (every row lands at its own physical
+    (block, offset) — the per-row write path decode, verify and chunked
+    prefill all share). int8 pools quantize at the write (per-row
+    absmax, models/quantize.quantize_kv) so the pool never holds a
+    dense copy.
+    """
+    block, offset = _physical(table, positions, entry["k"].shape[-3])
+    out = dict(entry)
+    for name, new in (("k", new_k), ("v", new_v)):
+        if f"{name}_scale" in entry:
+            q, scale = quantize_kv(new)
+            out[name] = entry[name].at[block, offset].set(q)
+            out[f"{name}_scale"] = \
+                entry[f"{name}_scale"].at[block, offset].set(scale)
+        else:
+            out[name] = entry[name].at[block, offset].set(
+                new.astype(entry[name].dtype))
+    return out
+
+
+def gather_kv(entry: tp.Dict, table: jax.Array, dtype
+              ) -> tp.Tuple[jax.Array, jax.Array]:
+    """Gather one layer's logical K/V views for a batch of tables.
+
+    Returns (k, v) of shape [B, max_blocks * bs, H, Dh] in
+    `dtype`: each slot's blocks concatenated in logical order,
+    sentinel entries included (they sit past every causal horizon, so
+    the attention mask — not the gather — keeps them out). int8 pools
+    dequantize inline; XLA fuses gather + convert + scale into the
+    attention operand read.
+    """
+    batch, entries = table.shape
+
+    def view(name):
+        g = entry[name][table]              # [B, E, bs, H, Dh]
+        if f"{name}_scale" in entry:
+            g = dequantize_kv(g, entry[f"{name}_scale"][table], dtype)
+        return g.astype(dtype).reshape(batch, entries * g.shape[2],
+                                       *g.shape[3:])
+
+    return view("k"), view("v")
+
+
+def paged_attention(q: jax.Array, entry: tp.Dict, table: jax.Array,
+                    positions: jax.Array, *, head_dim: int,
+                    dtype) -> jax.Array:
+    """Causal attention of queries against a slot-paged KV pool.
+
+    Args:
+        q: [B, T, H, Dh] queries (already rotary-embedded).
+        entry: one layer's pool dict (K/V already written for this
+            step's rows — mirrors the dense path, where the cache write
+            precedes the attend so a query sees itself).
+        table: [B, max_blocks] int32 block tables.
+        positions: [B, T] absolute query positions (drive the causal
+            mask: key logical position <= query position, which also
+            masks every sentinel entry — sentinels only occupy logical
+            positions beyond the slot's horizon).
+        head_dim: cfg.head_dim (scores scale).
+        dtype: compute dtype for the gathered K/V and the probs @ V.
+
+    Returns [B, T, H, Dh] attention outputs, f32 score accumulation —
+    the dense `_cached_self_attention` math over the same logical
+    rows. int8 pools fold the per-row scales into the SCORES (for K)
+    and the PROBS (for V) rather than dequantizing the gathered view:
+    the scale is constant over the contracted head_dim, so
+    `(q . k_int8) * s == q . (k_int8 * s)` up to float rounding, and
+    the multiply shrinks from a [B, L, H, Dh] tensor to the
+    [B, H, T, L] scores — 1/head_dim the work on the bandwidth-bound
+    read path.
+    """
+    batch, entries = table.shape
+
+    def view(name):
+        g = entry[name][table]              # [B, E, bs, H, Dh]
+        g = g.reshape(batch, entries * g.shape[2], *g.shape[3:])
+        s = entry.get(f"{name}_scale")
+        if s is not None:
+            # [B, E, bs, H] -> [B, H, 1, L] to broadcast over scores
+            s = s[table].reshape(batch, g.shape[1], g.shape[2])
+            s = s.transpose(0, 2, 1)[:, :, None, :]
+        return g.astype(dtype), s
+
+    k_view, k_scale = view("k")
+    v_view, v_scale = view("v")
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_view,
+                        preferred_element_type=jnp.float32) * scale
+    if k_scale is not None:
+        scores = scores * k_scale
+    key_pos = jnp.arange(k_view.shape[1])[None, :]
+    mask = key_pos[None] <= positions[:, :, None]
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if v_scale is not None:
+        probs = probs * v_scale
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dtype), v_view)
+
+
+def slot_kv(entry: tp.Dict, table_row, length: int, dtype=jnp.float32
+            ) -> tp.Tuple[jax.Array, jax.Array]:
+    """Read back one slot's logical K/V rows [length, H, Dh].
+
+    The test/debug readback: gathers the slot's table through the same
+    path the attention uses, truncated to the live prefix — what the
+    bit-identity proofs (paged vs fresh prefill, COW isolation) compare.
+    """
+    k, v = gather_kv(entry, jnp.asarray(table_row, jnp.int32)[None], dtype)
+    return k[0, :length], v[0, :length]
+
+
+def pool_bytes(cfg, num_blocks: int, block_size: int,
+               kv_dtype: str = "model") -> int:
+    """Total HBM bytes of the pool across layers (capacity planning).
+
+    Pure host arithmetic — the scheduler consults it every step for
+    the bytes-per-token gauge, so no jnp ops belong here.
+    """
+    import math
+
+    import numpy as np
+    spec = pool_spec(num_blocks, block_size, cfg.num_heads, cfg.head_dim,
+                     cfg.dtype, kv_dtype)
+    per_layer = sum(np.dtype(dt).itemsize * math.prod(shape)
+                    for shape, dt in spec.values())
+    return per_layer * cfg.num_layers
+
+
+def block_bytes(cfg, block_size: int, kv_dtype: str = "model") -> int:
+    """HBM bytes ONE block costs across layers (admission accounting)."""
+    return pool_bytes(cfg, 1, block_size, kv_dtype)

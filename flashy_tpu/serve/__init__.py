@@ -23,7 +23,15 @@
 #  * DraftProvider / NGramDraft /   k-token proposals: prompt-lookup
 #    ModelDraft                     (host-side, dependency-free) or a
 #                                   small TransformerLM mirror (draft)
-#  * ContinuousBatchingScheduler    queue, admission, chunked-prefill
+#  * BlockPool / PrefixIndex        paged KV cache (engine
+#                                   cache_layout='paged'): block-pool
+#                                   reservations, refcounted prefix
+#                                   sharing + copy-on-write forks,
+#                                   int8 K/V — more slots per HBM byte
+#                                   (paged; device half in
+#                                   ops/paged_attention.py)
+#  * ContinuousBatchingScheduler    queue, admission (slot + block-pool
+#                                   headroom), chunked-prefill
 #                                   interleave, retirement
 #  * CompileCache / bucket_length   per-bucket executables, hit/miss +
 #                                   recompile accounting via the PR 1
@@ -42,12 +50,15 @@
 from .compile_cache import CompileCache, bucket_length  # noqa
 from .draft import DraftProvider, ModelDraft, NGramDraft  # noqa
 from .engine import (  # noqa
-    DecodeEngine, SlotAllocator, SPAN_DECODE, SPAN_PREFILL,
+    DecodeEngine, SlotAllocator, SPAN_ADMIT, SPAN_DECODE, SPAN_PREFILL,
     SPAN_PREFILL_CHUNK, SPAN_VERIFY,
 )
 from .metrics import (  # noqa
     ServeMetrics, percentile, COUNTER_QUEUE, COUNTER_OCCUPANCY,
-    COUNTER_ACCEPTANCE,
+    COUNTER_ACCEPTANCE, COUNTER_POOL, COUNTER_PREFIX, COUNTER_KV_BYTES,
+)
+from .paged import (  # noqa
+    BlockPool, PoolExhausted, PrefixIndex, POOL_FAULT_SITE,
 )
 from .scheduler import ContinuousBatchingScheduler, QueueFull, Request  # noqa
 
@@ -55,7 +66,9 @@ __all__ = [
     "DecodeEngine", "SlotAllocator", "ContinuousBatchingScheduler",
     "Request", "QueueFull", "CompileCache", "bucket_length", "ServeMetrics",
     "DraftProvider", "NGramDraft", "ModelDraft",
+    "BlockPool", "PoolExhausted", "PrefixIndex", "POOL_FAULT_SITE",
     "percentile", "SPAN_DECODE", "SPAN_PREFILL", "SPAN_PREFILL_CHUNK",
     "SPAN_VERIFY", "COUNTER_QUEUE", "COUNTER_OCCUPANCY",
-    "COUNTER_ACCEPTANCE",
+    "COUNTER_ACCEPTANCE", "COUNTER_POOL", "COUNTER_PREFIX",
+    "COUNTER_KV_BYTES",
 ]

@@ -27,6 +27,7 @@ SPAN_PREFILL = "serve/prefill"
 SPAN_PREFILL_CHUNK = "serve/prefill_chunk"
 SPAN_DECODE = "serve/decode"
 SPAN_VERIFY = "serve/verify"
+SPAN_ADMIT = "serve/admit"
 
 
 class SlotAllocator:
@@ -122,6 +123,29 @@ class DecodeEngine:
             speculative verify step for this draft length (the step
             itself compiles on demand for any k — spec_k only moves
             the compile to warm-up).
+        cache_layout: 'dense' (default) keeps the `[S, max_seq_len]`
+            slab — the reference implementation exactness checks
+            compare against. 'paged' stores K/V in a global block pool
+            `[num_blocks, block_size, H, Dh]` with per-slot block
+            tables (serve/paged.py + ops/paged_attention.py):
+            admission reserves a request's whole block budget up
+            front, identical prompt prefixes are shared by refcount
+            through a content-hash index (with copy-on-write forks for
+            partially shared blocks), and the same ONE-executable-per-
+            shape discipline holds — tables and liveness are inputs,
+            never shapes. Paged engines always prefill in chunks
+            (`chunk` defaults to `block_size`).
+        block_size: tokens per pool block (paged only); must divide
+            `max_seq_len`.
+        num_blocks: pool size including the sentinel block (paged
+            only); defaults to worst case (every slot at max_seq_len)
+            — size it DOWN to serve more slots than HBM could hold
+            densely, admission backpressure keeps it safe.
+        kv_dtype: 'model' stores pool K/V in the compute dtype; 'int8'
+            quantizes cache writes (per-row/per-head absmax scales
+            stored beside the pool — models/quantize.quantize_kv),
+            halving-or-better cache bytes and decode read bandwidth.
+        prefix_cache: enable cross-request prefix sharing (paged only).
         cache_scope: prefix for this engine's compile-cache keys (and
             therefore its RecompileWatchdog entry names). REQUIRED
             whenever two engines coexist in one process — different
@@ -148,6 +172,11 @@ class DecodeEngine:
                  chunk: tp.Optional[int] = None,
                  tail_bucket: tp.Optional[int] = None,
                  spec_k: tp.Optional[int] = None,
+                 cache_layout: str = "dense",
+                 block_size: int = 16,
+                 num_blocks: tp.Optional[int] = None,
+                 kv_dtype: str = "model",
+                 prefix_cache: bool = True,
                  cache_scope: str = "",
                  compile_cache: tp.Optional[CompileCache] = None,
                  tracer: tp.Optional[Tracer] = None):
@@ -161,6 +190,23 @@ class DecodeEngine:
         self.slots = slots
         self.max_seq_len = min(max_seq_len or self._cfg.max_seq_len,
                                self._cfg.max_seq_len)
+        if cache_layout not in ("dense", "paged"):
+            raise ValueError(f"cache_layout must be 'dense' or 'paged', "
+                             f"got {cache_layout!r}")
+        if kv_dtype not in ("model", "int8"):
+            raise ValueError(f"kv_dtype must be 'model' or 'int8', "
+                             f"got {kv_dtype!r}")
+        if kv_dtype == "int8" and cache_layout != "paged":
+            raise ValueError("kv_dtype='int8' requires the paged cache "
+                             "layout (scales live beside the block pool)")
+        self.cache_layout = cache_layout
+        self.kv_dtype = kv_dtype
+        self.block_size = int(block_size)
+        if cache_layout == "paged" and chunk is None:
+            # paged engines always prefill in chunks: chunked prefill
+            # attends earlier (possibly shared) blocks through the
+            # table and can resume at any prefix-matched offset.
+            chunk = self.block_size
         self.temperature = float(temperature)
         if self.temperature > 0.0 and rng is None:
             raise ValueError("DecodeEngine(temperature>0) samples and needs "
@@ -203,9 +249,34 @@ class DecodeEngine:
 
         # Device-side per-slot state. Inactive slots park at position
         # `max_seq_len`: their decode writes fall out of range and are
-        # dropped (mode="drop" in the cache scatter), so a freed slot
-        # can never corrupt a neighbour.
-        self._cache = init_cache(self._cfg, slots, self.max_seq_len)
+        # dropped (mode="drop" in the dense cache scatter; clamped into
+        # the sentinel block in the paged layout), so a freed slot can
+        # never corrupt a neighbour.
+        if cache_layout == "paged":
+            from ..ops.paged_attention import block_bytes, init_pool
+            from .paged import BlockPool
+            if num_blocks is None:
+                # worst case: every slot reserves its full budget
+                num_blocks = 1 + slots * (self.max_seq_len
+                                          // self.block_size)
+            self.num_blocks = int(num_blocks)
+            self._pool = BlockPool(
+                num_blocks=self.num_blocks, block_size=self.block_size,
+                max_seq_len=self.max_seq_len,
+                spec_overshoot=self.spec_k or 0,
+                prefix_cache=prefix_cache)
+            self._cache = init_pool(self._cfg, self.num_blocks,
+                                    self.block_size, self.kv_dtype)
+            self._block_bytes = block_bytes(self._cfg, self.block_size,
+                                            self.kv_dtype)
+            self._table_host = np.zeros(
+                (slots, self._pool.max_blocks), np.int32)
+            self._table_dev = jnp.asarray(self._table_host)
+            self._table_dirty = False
+        else:
+            self.num_blocks = 0
+            self._pool = None
+            self._cache = init_cache(self._cfg, slots, self.max_seq_len)
         self._tokens = jnp.full((slots,), self.pad_token, jnp.int32)
         self._positions = jnp.full((slots,), self.max_seq_len, jnp.int32)
         self._active = jnp.zeros((slots,), bool)
@@ -238,11 +309,41 @@ class DecodeEngine:
         return jax.random.categorical(
             key, logits / self.temperature, axis=-1).astype(jnp.int32)
 
+    def _table(self):
+        """Device copy of the block tables, refreshed only when the host
+        tables changed (admission / COW / retirement — never mid-decode,
+        reservations are materialized up front)."""
+        import jax.numpy as jnp
+        if self._table_dirty:
+            self._table_dev = jnp.asarray(self._table_host)
+            self._table_dirty = False
+        return self._table_dev
+
+    def _layout_args(self) -> tp.Tuple:
+        """Extra compiled-step inputs the layout needs (the block
+        tables, right after the cache operand) — empty for dense."""
+        return (self._table(),) if self.cache_layout == "paged" else ()
+
     def _build_decode(self) -> tp.Callable:
         import jax
         import jax.numpy as jnp
         from ..models.decoding import _apply_step
         model, cfg, pad = self._model, self._cfg, self.pad_token
+
+        if self.cache_layout == "paged":
+            from .paged import paged_apply_step
+
+            def decode_paged(params, cache, table, tokens, positions,
+                             active, key):
+                # identical contract to the dense step; the table is
+                # one more INPUT (contents never change the shape)
+                logits, cache = paged_apply_step(
+                    model, params, cfg, tokens[:, None],
+                    positions[:, None], cache, table)
+                nxt = self._sample(logits[:, -1], key)
+                return jnp.where(active, nxt, jnp.int32(pad)), cache
+
+            return jax.jit(decode_paged, donate_argnums=self._donate)
 
         def decode(params, cache, tokens, positions, active, key):
             # tokens/positions/active: [S]; ONE executable for any mix
@@ -290,6 +391,29 @@ class DecodeEngine:
         from ..models.decoding import _apply_step
         model, cfg = self._model, self._cfg
 
+        if self.cache_layout == "paged":
+            from .paged import paged_apply_step
+
+            def chunk_paged(params, cache, table, tokens, start, used,
+                            slot, key):
+                # tokens: [1, size] at absolute positions start.. —
+                # attention reaches the slot's EARLIER blocks (its own
+                # previous chunks AND any prefix-shared blocks) through
+                # its table row, so chunked prefill and prefix sharing
+                # compose with zero copies. Pad rows beyond `used`
+                # write at higher positions — past every causal horizon
+                # until overwritten, the same right-padding proof.
+                row = jax.lax.dynamic_slice(
+                    table, (slot, 0), (1, table.shape[1]))
+                positions = (start + jnp.arange(size, dtype=jnp.int32))[None]
+                logits, cache = paged_apply_step(
+                    model, params, cfg, tokens, positions, cache, row)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], used - 1, axis=0, keepdims=True)
+                return self._sample(last, key)[0], cache
+
+            return jax.jit(chunk_paged, donate_argnums=self._donate)
+
         def chunk_step(params, cache, tokens, start, used, slot, key):
             # tokens: [1, size] right-padded slice of the prompt whose
             # real tokens sit at absolute positions start..start+used-1.
@@ -327,6 +451,37 @@ class DecodeEngine:
         from ..models.decoding import _apply_step, speculative_acceptance
         model, cfg, pad = self._model, self._cfg, self.pad_token
 
+        if self.cache_layout == "paged":
+            from .paged import paged_apply_step
+
+            def verify_paged(params, cache, table, tokens, drafts,
+                             positions, active, key):
+                # same [S, k+1] contract as the dense verify; rollback
+                # is free on the paged layout too — stale draft rows
+                # sit at positions past accepted+1, beyond every causal
+                # horizon until overwritten, whatever block they landed
+                # in (overshoot past the reservation clamps into the
+                # sentinel).
+                toks = jnp.concatenate([tokens[:, None], drafts], axis=1)
+                pos = positions[:, None] \
+                    + jnp.arange(k + 1, dtype=jnp.int32)[None]
+                logits, cache = paged_apply_step(
+                    model, params, cfg, toks, pos, cache, table)
+                out, accepted = speculative_acceptance(
+                    drafts, logits, temperature=self.temperature,
+                    rng=key if self.temperature > 0.0 else None,
+                    pad_token=pad)
+                out = jnp.where(active[:, None], out, jnp.int32(pad))
+                accepted = jnp.where(active, accepted, 0)
+                last = jnp.take_along_axis(out, accepted[:, None],
+                                           axis=1)[:, 0]
+                new_tokens = jnp.where(active, last, jnp.int32(pad))
+                new_positions = jnp.where(active, positions + accepted + 1,
+                                          positions)
+                return out, accepted, new_tokens, new_positions, cache
+
+            return jax.jit(verify_paged, donate_argnums=self._donate)
+
         def verify(params, cache, tokens, drafts, positions, active, key):
             # tokens/positions/active: [S]; drafts: [S, k]. ONE forward
             # scores the last emitted token plus all k drafts per slot
@@ -356,6 +511,16 @@ class DecodeEngine:
 
         return jax.jit(verify, donate_argnums=self._donate)
 
+    def _build_copy(self) -> tp.Callable:
+        """The COW fork executable: duplicate pool block `src` onto
+        `dst` across every layer and leaf (int8 payloads + scales).
+        Scalars are inputs, so one compiled copy serves every fork."""
+        import jax
+        from .paged import copy_block_fn
+        copy = copy_block_fn(self._cfg.scan_layers)
+        return jax.jit(lambda cache, src, dst: copy(cache, src, dst),
+                       donate_argnums=(0,) if self._donate else ())
+
     def _next_key(self):
         import jax
         if self.temperature <= 0.0:
@@ -381,15 +546,20 @@ class DecodeEngine:
         """
         import jax.numpy as jnp
         warmed = []
+        layout = self._layout_args()
         if self.chunk is not None:
-            # chunked mode: the whole prefill lifetime is two shapes
+            # chunked mode: the whole prefill lifetime is two shapes.
+            # In the paged layout the scratch run's tables are all
+            # sentinel, so warm-up K/V lands in the sentinel block and
+            # can never touch a real one.
             for size in sorted({self.chunk, self.tail_bucket}):
                 dummy = jnp.full((1, size), self.pad_token, jnp.int32)
                 _, self._cache = self.compile_cache.warm(
                     self._key("prefill_chunk", size),
                     lambda: self._build_prefill_chunk(size),
-                    self._params, self._cache, dummy, jnp.int32(0),
-                    jnp.int32(1), jnp.int32(0), self._next_key())
+                    self._params, self._cache, *layout, dummy,
+                    jnp.int32(0), jnp.int32(1), jnp.int32(0),
+                    self._next_key())
                 warmed.append(f"prefill_chunk/{size}")
         else:
             buckets = {self.min_bucket}
@@ -404,8 +574,8 @@ class DecodeEngine:
                 warmed.append(f"prefill/{bucket}")
         _, self._cache = self.compile_cache.warm(
             self._key("decode", self.slots), self._build_decode,
-            self._params, self._cache, self._tokens, self._positions,
-            self._active, self._next_key())
+            self._params, self._cache, *layout, self._tokens,
+            self._positions, self._active, self._next_key())
         warmed.append(f"decode/{self.slots}")
         if self.spec_k is not None:
             dummy_drafts = jnp.full((self.slots, self.spec_k),
@@ -413,9 +583,17 @@ class DecodeEngine:
             *_, self._cache = self.compile_cache.warm(
                 self._key("verify", self.slots, self.spec_k),
                 lambda: self._build_verify(self.spec_k),
-                self._params, self._cache, self._tokens, dummy_drafts,
-                self._positions, self._active, self._next_key())
+                self._params, self._cache, *layout, self._tokens,
+                dummy_drafts, self._positions, self._active,
+                self._next_key())
             warmed.append(f"verify/{self.slots}/{self.spec_k}")
+        if self.cache_layout == "paged":
+            # sentinel -> sentinel: a no-op that compiles + warms the
+            # COW fork copy so a prefix fork never traces mid-traffic
+            self._cache = self.compile_cache.warm(
+                self._key("copy_block"), self._build_copy,
+                self._cache, jnp.int32(0), jnp.int32(0))
+            warmed.append("copy_block")
         # warm-up wrote scratch K/V at slot 0 position 0; a real prefill
         # overwrites it before that slot ever decodes, but reset the
         # host-visible state anyway so the engine starts pristine.
@@ -433,11 +611,96 @@ class DecodeEngine:
         A specific `slot` can be requested (mirrored draft engines)."""
         return self.allocator.acquire(slot)
 
+    def can_admit(self, prompt: np.ndarray, max_new_tokens: int) -> bool:
+        """Whether the cache layout has room for this request RIGHT NOW
+        (beyond a free slot, which the caller checks separately).
+
+        Dense: always — the slot IS the reservation. Paged: the block
+        pool must cover the request's whole budget net of its prefix-
+        cache credit; a False keeps the request queued (head-of-line:
+        admission stays FIFO), and the queue filling up turns into
+        QueueFull at the submit door — the existing backpressure path.
+        """
+        if self._pool is None:
+            return True
+        return self._pool.can_admit(np.asarray(prompt, np.int32),
+                                    max_new_tokens)
+
+    def admit(self, slot: int, prompt: np.ndarray,
+              max_new_tokens: int) -> int:
+        """Reserve the request's cache and return the prefill start.
+
+        Dense: a no-op returning 0 (prefill covers the whole prompt).
+        Paged: reserves every block the request can touch (prompt +
+        output budget + verify overshoot) so decode can never OOM the
+        pool; walks the prefix index, bumping refcounts on shared full
+        blocks and device-copying the COW fork for a partially shared
+        block; fills the slot's table row. Returns the number of
+        prompt tokens served from the cache — chunked prefill resumes
+        there (always < len(prompt): the last token re-prefills so the
+        first-token logits come from a real forward). Raises
+        PoolExhausted (atomically — no state changed) when the pool
+        lacks headroom or the `serve.pool` fault site injects a
+        failure.
+        """
+        if self._pool is None:
+            return 0
+        import jax.numpy as jnp
+        if slot not in self.allocator.live:
+            raise ValueError(f"slot {slot} was not acquired")
+        prompt = np.asarray(prompt, np.int32)
+        plan = self._pool.plan(prompt, max_new_tokens)
+        row, start, cow = self._pool.commit(plan, slot)
+        self._table_host[slot] = row
+        self._table_dirty = True
+        if cow is not None:
+            src, dst = cow
+            fn = self.compile_cache.get(self._key("copy_block"),
+                                        self._build_copy)
+            self._cache = fn(self._cache, jnp.int32(src), jnp.int32(dst))
+        if self.tracer is not None:
+            self.tracer.instant(SPAN_ADMIT, category="serve", slot=slot,
+                                matched=start, prompt=int(prompt.size),
+                                cow=cow is not None)
+        return start
+
+    def pool_stats(self) -> tp.Optional[tp.Dict[str, float]]:
+        """Block-pool occupancy/prefix counters plus bytes-per-token
+        (None on the dense layout). `kv_bytes_per_token` is the pool
+        bytes actually reserved per live token — the number the paged
+        layout exists to shrink."""
+        if self._pool is None:
+            return None
+        stats = self._pool.stats()
+        per_block = self._block_bytes
+        live_tokens = int(sum(self._positions_host[self._active_host]))
+        stats["kv_bytes_per_token"] = (
+            stats["in_use"] * per_block / live_tokens if live_tokens
+            else 0.0)
+        return stats
+
+    def cache_bytes(self) -> int:
+        """Total HBM bytes this engine's KV cache occupies (the fixed
+        budget the paged-vs-dense capacity comparison holds constant)."""
+        if self._pool is not None:
+            from ..ops.paged_attention import pool_bytes
+            return pool_bytes(self._cfg, self.num_blocks, self.block_size,
+                              self.kv_dtype)
+        import jax
+        return int(sum(leaf.size * leaf.dtype.itemsize
+                       for leaf in jax.tree_util.tree_leaves(self._cache)))
+
     def prefill(self, slot: int, prompt: np.ndarray) -> int:
         """Run `prompt` (1-D int tokens) into `slot`; returns the first
         generated token. The slot must have been `acquire()`d."""
         import jax.numpy as jnp
         prompt = np.asarray(prompt)
+        if self.cache_layout == "paged":
+            raise ValueError(
+                "paged engines prefill in chunks (chunk is always set): "
+                "use admit() + prefill_chunk() — the monolithic bucketed "
+                "prefill writes through a dense mini-cache merge that "
+                "has no meaning for a block pool")
         if prompt.ndim != 1 or prompt.size < 1:
             raise ValueError(f"prompt must be 1-D and non-empty, "
                              f"got shape {prompt.shape}")
@@ -511,6 +774,7 @@ class DecodeEngine:
                 if self.tracer else _null_span())
         with span:
             first, self._cache = fn(self._params, self._cache,
+                                    *self._layout_args(),
                                     jnp.asarray(padded), jnp.int32(start),
                                     jnp.int32(used), jnp.int32(slot),
                                     self._next_key())
@@ -518,6 +782,10 @@ class DecodeEngine:
                 first = int(first)
         if not final:
             return start + used, None
+        if self._pool is not None:
+            # prompt fully written: index its full blocks so later
+            # admissions share them instead of re-prefilling
+            self._pool.on_live(slot)
         self._tokens = self._tokens.at[slot].set(first)
         self._positions = self._positions.at[slot].set(length)
         self._active = self._active.at[slot].set(True)
@@ -535,7 +803,8 @@ class DecodeEngine:
                                  live=self.allocator.live_count)
                 if self.tracer else _null_span())
         with span:
-            tokens, self._cache = fn(self._params, self._cache, self._tokens,
+            tokens, self._cache = fn(self._params, self._cache,
+                                     *self._layout_args(), self._tokens,
                                      self._positions, self._active,
                                      self._next_key())
             out = np.asarray(tokens)
@@ -575,8 +844,9 @@ class DecodeEngine:
                 if self.tracer else _null_span())
         with span:
             out, accepted, self._tokens, self._positions, self._cache = fn(
-                self._params, self._cache, self._tokens, jnp.asarray(drafts),
-                self._positions, self._active, self._next_key())
+                self._params, self._cache, *self._layout_args(),
+                self._tokens, jnp.asarray(drafts), self._positions,
+                self._active, self._next_key())
             out_np = np.asarray(out)
             accepted_np = np.asarray(accepted)
         self._positions_host += np.where(self._active_host,
@@ -604,12 +874,20 @@ class DecodeEngine:
 
     def retire(self, slot: int) -> None:
         """Free `slot`: deactivate it and park its position out of range
-        so pending decode writes drop instead of landing in the cache."""
+        so pending decode writes drop instead of landing in the cache
+        (dense mode="drop"; paged writes clamp into the sentinel). On
+        the paged layout the slot's block refcounts drop too — blocks
+        no table references return to the free list, except prompt
+        blocks the prefix index still caches for future admissions."""
         self._active = self._active.at[slot].set(False)
         self._positions = self._positions.at[slot].set(self.max_seq_len)
         self._tokens = self._tokens.at[slot].set(self.pad_token)
         self._positions_host[slot] = self.max_seq_len
         self._active_host[slot] = False
+        if self._pool is not None and self._pool.holds(slot):
+            self._pool.release(slot)
+            self._table_host[slot] = 0
+            self._table_dirty = True
         self.allocator.release(slot)
 
     def slot_length(self, slot: int) -> int:

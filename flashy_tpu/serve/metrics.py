@@ -22,6 +22,9 @@ from ..xp import SERVE_STATUS_NAME, AnyPath
 COUNTER_QUEUE = "serve/queue_depth"
 COUNTER_OCCUPANCY = "serve/slot_occupancy"
 COUNTER_ACCEPTANCE = "serve/acceptance"
+COUNTER_POOL = "serve/pool_occupancy"
+COUNTER_PREFIX = "serve/prefix_hit"
+COUNTER_KV_BYTES = "serve/kv_bytes_per_token"
 
 
 class ServeMetrics:
@@ -36,6 +39,11 @@ class ServeMetrics:
 
     def __init__(self, tracer: tp.Optional[Tracer] = None):
         self.tracer = tracer
+        # non-numeric facts about the serving setup (cache layout, KV
+        # dtype — filled by the scheduler from its engine); written to
+        # serve.json beside the numeric summary so `flashy_tpu.info`
+        # can show WHAT was serving, not just how fast
+        self.static_info: tp.Dict[str, tp.Any] = {}
         self.submitted = 0
         self.completed = 0
         self.rejected = 0
@@ -53,6 +61,13 @@ class ServeMetrics:
         self.spec_accepted = 0
         self.spec_emitted = 0
         self.accepted_per_step: tp.List[int] = []
+        # paged KV cache: block-pool occupancy + prefix-cache hits
+        self.pool_occupancy: tp.List[float] = []
+        self.kv_bytes_per_token: tp.List[float] = []
+        self.prefix_matched_tokens = 0
+        self.prefix_prompt_tokens = 0
+        self.prefix_admissions = 0
+        self.prefix_hits = 0
 
     # ------------------------------------------------------------------
     # scheduler hooks
@@ -99,6 +114,34 @@ class ServeMetrics:
                 COUNTER_ACCEPTANCE,
                 rate=self.spec_accepted / self.spec_drafted)
 
+    def on_prefix(self, matched_tokens: int, prompt_tokens: int) -> None:
+        """One paged admission: `matched_tokens` of the prompt were
+        served from the prefix cache (refcount bump / COW fork instead
+        of prefill); a hit is any admission with matched > 0."""
+        self.prefix_admissions += 1
+        self.prefix_matched_tokens += matched_tokens
+        self.prefix_prompt_tokens += prompt_tokens
+        if matched_tokens > 0:
+            self.prefix_hits += 1
+        if self.tracer is not None and self.prefix_prompt_tokens:
+            self.tracer.counter(
+                COUNTER_PREFIX,
+                hit_rate=self.prefix_matched_tokens
+                / self.prefix_prompt_tokens)
+
+    def on_pool(self, occupancy: float, in_use: int, capacity: int,
+                cached: int, bytes_per_token: float) -> None:
+        """Sample the block pool (once per step, paged layout only)."""
+        self.pool_occupancy.append(occupancy)
+        if bytes_per_token > 0:
+            self.kv_bytes_per_token.append(bytes_per_token)
+        if self.tracer is not None:
+            self.tracer.counter(COUNTER_POOL, in_use=in_use,
+                                cached=cached, occupancy=occupancy)
+            if bytes_per_token > 0:
+                self.tracer.counter(COUNTER_KV_BYTES,
+                                    bytes=bytes_per_token)
+
     def on_gauges(self, queue_depth: int, live: int, capacity: int) -> None:
         """Sample the queue depth + slot occupancy (once per step)."""
         occupancy = live / capacity if capacity else 0.0
@@ -128,6 +171,17 @@ class ServeMetrics:
                                      ("occupancy", self.occupancy, 1)):
             out[f"{name}_p50"] = percentile(samples, 50) * scale
             out[f"{name}_p95"] = percentile(samples, 95) * scale
+        if self.pool_occupancy:
+            out["pool_occupancy_p50"] = percentile(self.pool_occupancy, 50)
+            out["pool_occupancy_p95"] = percentile(self.pool_occupancy, 95)
+        if self.kv_bytes_per_token:
+            out["kv_bytes_per_token_p50"] = percentile(
+                self.kv_bytes_per_token, 50)
+        if self.prefix_admissions:
+            out["prefix_hit_rate"] = (
+                self.prefix_matched_tokens / self.prefix_prompt_tokens
+                if self.prefix_prompt_tokens else 0.0)
+            out["prefix_hit_requests"] = self.prefix_hits
         if self.spec_steps:
             out["spec_drafted"] = self.spec_drafted
             out["spec_emitted"] = self.spec_emitted
@@ -161,7 +215,8 @@ class ServeMetrics:
         """Snapshot the summary to `<folder>/serve.json` (atomic) for
         `python -m flashy_tpu.info`; returns the path."""
         target = Path(folder) / SERVE_STATUS_NAME
-        payload = self.summary()
+        payload: tp.Dict[str, tp.Any] = dict(self.static_info)
+        payload.update(self.summary())
         if extra:
             payload.update(extra)
         target.parent.mkdir(parents=True, exist_ok=True)

@@ -19,6 +19,7 @@ import numpy as np
 
 from .engine import DecodeEngine
 from .metrics import ServeMetrics
+from .paged import PoolExhausted
 
 logger = logging.getLogger(__name__)
 
@@ -76,6 +77,15 @@ class ContinuousBatchingScheduler:
     batch boundary — capacity freed mid-stream is refilled on the next
     step while the other slots keep generating.
 
+    On a paged engine (`DecodeEngine(cache_layout='paged')`) admission
+    additionally gates on BLOCK-POOL headroom: the queue head waits
+    (FIFO) until the pool can reserve its whole prompt + output
+    budget, `engine.admit()` walks the prefix cache (its return is
+    where chunked prefill resumes — shared prompt tokens are never
+    recomputed), and an injected/raced `PoolExhausted` re-queues the
+    request instead of crashing. Pool occupancy and prefix-hit samples
+    flow to the metrics each step.
+
     On a chunked-prefill engine (`DecodeEngine(chunk=...)`) admission
     assigns the slot immediately but the prompt is prefilled in fixed
     `chunk` slices, at most `prefill_chunks_per_step` slices per
@@ -108,6 +118,9 @@ class ContinuousBatchingScheduler:
         self.engine = engine
         self.max_queue = max_queue
         self.metrics = metrics or ServeMetrics(tracer=engine.tracer)
+        self.metrics.static_info.setdefault("cache_layout",
+                                            engine.cache_layout)
+        self.metrics.static_info.setdefault("kv_dtype", engine.kv_dtype)
         self.draft = draft
         if draft is not None and engine.spec_k is not None \
                 and draft.k != engine.spec_k:
@@ -259,8 +272,34 @@ class ContinuousBatchingScheduler:
                 request.finished_at = time.perf_counter()
                 self.metrics.on_expired()
                 continue
+            if not self.engine.can_admit(request.prompt,
+                                         request.max_new_tokens):
+                # paged layout: the block pool lacks headroom for the
+                # queue head's whole budget. Admission stays FIFO — the
+                # head waits at the front for retirements to free
+                # blocks; meanwhile the queue filling up surfaces as
+                # QueueFull at the submit door (backpressure, by
+                # design never an over-committed pool).
+                self._queue.appendleft(request)
+                break
             slot = self.engine.acquire_slot()
             assert slot is not None
+            try:
+                start = self.engine.admit(slot, request.prompt,
+                                          request.max_new_tokens)
+            except PoolExhausted as exc:
+                # an injected allocation failure (chaos drill,
+                # `serve.pool` fault site) or headroom lost since the
+                # check: release the slot, keep the request queued.
+                # The scheduler sheds via backpressure — QueueFull at
+                # the door, TTL expiry in the queue — never a crash.
+                logger.warning("admission of request %d shed: %s",
+                               request.uid, exc)
+                self.engine.allocator.release(slot)
+                self._queue.appendleft(request)
+                break
+            if self.engine.cache_layout == "paged":
+                self.metrics.on_prefix(start, int(request.prompt.size))
             request.slot = slot
             self.admitted_order.append(request.uid)
             admitted += 1
@@ -268,8 +307,11 @@ class ContinuousBatchingScheduler:
                 first = self.engine.prefill(slot, request.prompt)
                 self._first_token(slot, request, first)
             else:
+                # prefill resumes where the prefix cache left off
+                # (start > 0 is a prefix hit: those tokens' K/V are
+                # shared by reference, never recomputed)
                 request.state = "prefilling"
-                self._prefilling[slot] = [request, 0]
+                self._prefilling[slot] = [request, start]
         # advance chunked prefills, bounded per step (the stall bound)
         self.prefill_tokens_last_step = 0
         budget = self.prefill_chunks_per_step
@@ -338,6 +380,14 @@ class ContinuousBatchingScheduler:
         self.metrics.on_gauges(queue_depth=len(self._queue),
                                live=self.engine.live_count,
                                capacity=self.engine.slots)
+        pool = self.engine.pool_stats()
+        if pool is not None:
+            self.metrics.on_pool(
+                occupancy=pool["occupancy"],
+                in_use=int(pool["in_use"]),
+                capacity=int(pool["capacity"]),
+                cached=int(pool["cached"]),
+                bytes_per_token=pool["kv_bytes_per_token"])
         if not self._running:
             return 0
         step_start = time.perf_counter()

@@ -563,3 +563,85 @@ def test_info_verify_checkpoint_cli(tmp_path, capsys):
 def test_chaos_drill_end_to_end(tmp_path):
     from flashy_tpu.resilience.__main__ import run_drill
     assert run_drill(epochs=5, root=str(tmp_path)) == 0
+
+
+# ----------------------------------------------------------------------
+# serving block pool: injected allocation failure sheds, never crashes
+# ----------------------------------------------------------------------
+def _paged_serving_stack(slots=2, vocab=32):
+    import jax
+    import jax.numpy as jnp
+
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+    from flashy_tpu.serve import ContinuousBatchingScheduler, DecodeEngine
+
+    cfg = TransformerConfig(vocab_size=vocab, dim=16, num_layers=1,
+                            num_heads=2, attention="dense", max_seq_len=32,
+                            dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32))
+    engine = DecodeEngine(model, params, slots=slots, cache_layout="paged",
+                          block_size=4)
+    engine.warmup()
+    return engine, ContinuousBatchingScheduler(engine)
+
+
+def test_serve_pool_fault_sheds_instead_of_crashing(injector):
+    """An injected `serve.pool` allocation failure must keep the request
+    queued (shed via backpressure) and admit it cleanly on a later
+    step once the fault clears — the scheduler never crashes and the
+    pool never leaks a block."""
+    import numpy as np
+
+    engine, scheduler = _paged_serving_stack()
+    injector.fail_at("serve.pool", call=1)
+    prompt = np.arange(1, 7, dtype=np.int32)
+    handle = scheduler.submit(prompt, max_new_tokens=3)
+    scheduler.step()  # admission hits the injected fault: shed, queued
+    assert handle.state == "queued"
+    assert scheduler.queue_depth == 1
+    assert engine.live_count == 0  # the acquired slot was released
+    engine._pool.check()  # nothing leaked by the aborted admission
+    assert injector.hits("serve.pool") == 1
+    scheduler.run()  # fault cleared: admitted and served normally
+    assert handle.done and handle.finish_reason in ("eos", "length")
+    assert len(handle.generated) == 3
+
+
+def test_serve_pool_fault_then_ttl_expiry(injector):
+    """A request stuck behind a persistent pool fault is shed by its
+    TTL as 'expired' — the documented degradation path — while the
+    scheduler keeps stepping."""
+    import numpy as np
+
+    engine, scheduler = _paged_serving_stack()
+    injector.fail_at("serve.pool", call=1, times=1000)
+    handle = scheduler.submit(np.arange(1, 7, dtype=np.int32),
+                              max_new_tokens=3, ttl=1e-3)
+    for _ in range(50):
+        scheduler.step()
+        if handle.done:
+            break
+    assert handle.done and handle.finish_reason == "expired"
+    assert scheduler.metrics.expired == 1
+    assert engine.live_count == 0
+    engine._pool.check()
+
+
+def test_serve_pool_fault_queuefull_backpressure(injector):
+    """With admissions blocked by injected pool faults, the queue cap
+    still raises QueueFull at the submit door (backpressure reaches
+    the client instead of an allocation crash)."""
+    import numpy as np
+
+    from flashy_tpu.serve import QueueFull
+
+    engine, scheduler = _paged_serving_stack()
+    scheduler.max_queue = 2
+    injector.fail_at("serve.pool", call=1, times=1000)
+    scheduler.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=2)
+    scheduler.step()
+    scheduler.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=2)
+    with pytest.raises(QueueFull):
+        scheduler.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=2)
+    assert scheduler.metrics.rejected == 1
