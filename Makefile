@@ -14,6 +14,20 @@ linter:
 tests:
 	python -m pytest tests -x -q -m "not slow"
 
+# Project-aware static lint (flashy_tpu.analysis): trace-leak,
+# shape-policy, fault-site-registry, stateful-attr, collective
+# accounting and telemetry-naming invariants (FT001-FT006). Exit 1 on
+# any NEW violation vs the committed .analysis-baseline.json. The
+# analyzer itself is additionally type-checked with mypy when
+# available (CI installs it via the dev extras).
+analyze:
+	python -m flashy_tpu.analysis
+	@if python -m mypy --version >/dev/null 2>&1; then \
+		python -m mypy --config-file mypy.ini flashy_tpu/analysis; \
+	else \
+		echo "mypy not installed; skipping analyzer type check"; \
+	fi
+
 tests-all:
 	python -m pytest tests -x -q
 
@@ -86,7 +100,7 @@ docs:
 	python tools/gendocs.py -o docs/api -p flashy_tpu \
 		-c 'flashy_tpu.observability*' -c 'flashy_tpu.serve*' \
 		-c 'flashy_tpu.resilience*' -c 'flashy_tpu.parallel*' \
-		-c 'flashy_tpu.datapipe*'
+		-c 'flashy_tpu.datapipe*' -c 'flashy_tpu.analysis*'
 
 native:
 	python tools/build_native.py
@@ -94,4 +108,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo zero-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all analyze coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo zero-demo datapipe-demo docs native dist

@@ -36,6 +36,7 @@ from .solver import BaseSolver  # noqa
 from .utils import averager  # noqa
 from .ema import EMA, ema_update  # noqa
 from .xp import get_xp, main  # noqa
+from . import analysis  # noqa — project-aware static lint (stdlib-only)
 from . import serve  # noqa — continuous-batching inference serving
 from . import resilience  # noqa — fault tolerance (preemption, integrity, retry)
 from .resilience import enable_preemption_guard  # noqa

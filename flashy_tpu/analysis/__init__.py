@@ -1,0 +1,85 @@
+# Project-aware static analysis (the compile-before-the-compile). Eight
+# PRs of growth accumulated load-bearing conventions that nothing
+# checked until a demo failed at runtime: "liveness is an input, never
+# a shape", the `-start` collective accounting convention, fault_point
+# site strings that silently never fire when typo'd, and solver
+# attributes that must be register_stateful'd to survive a commit. On
+# XLA the dominant correctness/perf failure mode is host state leaking
+# into traced code (PAPERS.md, the pjit/TPUv4 line) — exactly what an
+# AST linter that knows THIS project's invariants can catch before
+# tracing. Stdlib-only: importable (and CI-runnable) without jax.
+"""flashy_tpu.analysis — project-aware static lint (FT001-FT006).
+
+Run it with ``python -m flashy_tpu.analysis`` (or ``make analyze``).
+Checkers:
+
+* **FT001 trace-leak** — host conversions (``int()``/``.item()``/
+  ``np.asarray``/``.block_until_ready``) and Python branches on traced
+  values inside functions reachable from ``jax.jit``/``wrap``/
+  ``shard_map``; host syncs in serve/decode hot paths.
+* **FT002 shape-policy** — ``len()``/``.shape``-derived shapes feeding
+  compiled executables in ``serve/`` and ``datapipe/`` ("data, never
+  shapes").
+* **FT003 fault-site** — ``fail_at``/``preempt_at``/``act_at`` naming a
+  site no ``fault_point`` ever fires, plus staleness of the generated
+  :mod:`flashy_tpu.analysis.registry`.
+* **FT004 stateful-attr** — solver attributes holding
+  ``state_dict``-bearing objects that are never ``register_stateful``'d.
+* **FT005 collective-accounting** — hand-rolled ``*-start`` collective
+  counting outside ``parallel.accounting``.
+* **FT006 telemetry-track** — counter/instant track literals off the
+  ``sub/name`` convention.
+
+Suppress a single line with ``# flashy: noqa[FT001]`` (or a blanket
+``# flashy: noqa``); grandfather existing findings into the committed
+baseline with ``--write-baseline`` — the CI gate is *no new
+violations*.
+"""
+import typing as tp
+
+from .core import (Checker, Finding, ProjectIndex, SourceFile,  # noqa: F401
+                   build_index, discover_files, run_checks)
+from .baseline import (fingerprint, load_baseline, new_findings,  # noqa: F401
+                       save_baseline)
+from .trace_leak import TraceLeakChecker
+from .shape_policy import ShapePolicyChecker
+from .fault_sites import (FaultSiteChecker,  # noqa: F401
+                          generate_registry_source, registry_module_path)
+from .stateful import StatefulAttrChecker
+from .collectives import CollectiveAccountingChecker
+from .telemetry_names import TelemetryNameChecker
+
+__all__ = [
+    "ALL_CHECKERS", "Checker", "Finding", "ProjectIndex", "SourceFile",
+    "analyze", "build_index", "checker_by_code", "discover_files",
+    "run_checks", "generate_registry_source", "registry_module_path",
+]
+
+ALL_CHECKERS: tp.Tuple[Checker, ...] = (
+    TraceLeakChecker(),
+    ShapePolicyChecker(),
+    FaultSiteChecker(),
+    StatefulAttrChecker(),
+    CollectiveAccountingChecker(),
+    TelemetryNameChecker(),
+)
+
+
+def checker_by_code(code: str) -> Checker:
+    for checker in ALL_CHECKERS:
+        if checker.code == code:
+            return checker
+    raise KeyError(code)
+
+
+def analyze(paths: tp.Sequence[tp.Any], root: tp.Any,
+            select: tp.Optional[tp.Sequence[str]] = None,
+            ) -> tp.List[Finding]:
+    """Programmatic one-shot: active (non-suppressed) findings for
+    `paths` under `root`, optionally restricted to checker `select`."""
+    from pathlib import Path
+    checkers = (list(ALL_CHECKERS) if select is None
+                else [checker_by_code(code) for code in select])
+    files = discover_files([Path(p) for p in paths], Path(root))
+    findings, _ = run_checks(files, checkers)
+    return findings

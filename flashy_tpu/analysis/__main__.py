@@ -1,0 +1,139 @@
+# `python -m flashy_tpu.analysis` / `make analyze` — the CI gate.
+# Exit 0: no new findings vs the committed baseline. Exit 1: new
+# findings (each printed with its stable code and autofix hint).
+# Exit 2: usage/internal error. `--write-registry` regenerates the
+# committed fault-site registry; `--write-baseline` re-grandfathers
+# the current findings.
+"""CLI for the project-aware static analyzer."""
+from pathlib import Path
+import argparse
+import sys
+import typing as tp
+
+from . import ALL_CHECKERS, checker_by_code
+from .baseline import (DEFAULT_BASELINE_NAME, load_baseline, new_findings,
+                       save_baseline)
+from .core import build_index, discover_files, run_checks
+from .fault_sites import generate_registry_source
+
+
+def _default_root() -> Path:
+    cwd = Path.cwd()
+    if (cwd / "flashy_tpu").is_dir():
+        return cwd
+    return Path(__file__).resolve().parents[2]
+
+
+def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flashy_tpu.analysis",
+        description="Project-aware static lint: trace-leak, shape-policy, "
+                    "fault-site, stateful-attr, collective-accounting and "
+                    "telemetry-naming invariants (codes FT001-FT006). "
+                    "Suppress a single line with `# flashy: noqa[FTxxx]`.")
+    parser.add_argument("paths", nargs="*", type=Path,
+                        help="files/directories to scan (default: the "
+                             "repo root containing flashy_tpu/)")
+    parser.add_argument("--root", type=Path, default=None,
+                        help="root for relative paths and exclusions "
+                             "(default: inferred)")
+    parser.add_argument("--select", default=None, metavar="FT001,FT002",
+                        help="comma-separated checker codes to run")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help=f"baseline file (default: "
+                             f"<root>/{DEFAULT_BASELINE_NAME})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report every finding")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="grandfather the current findings and exit 0")
+    parser.add_argument("--write-registry", action="store_true",
+                        help="regenerate flashy_tpu/analysis/registry.py "
+                             "from the scanned fault_point sites")
+    parser.add_argument("--list-checks", action="store_true",
+                        help="describe every checker and exit")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="print only the summary line")
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for checker in ALL_CHECKERS:
+            print(f"{checker.code} {checker.name}: {checker.explain}")
+        return 0
+
+    root = (args.root or _default_root()).resolve()
+    paths = [p if p.is_absolute() else root / p for p in args.paths]
+    if not paths:
+        paths = [root]
+    for path in paths:
+        if not path.exists():
+            print(f"error: no such path: {path}", file=sys.stderr)
+            return 2
+        try:
+            path.resolve().relative_to(root)
+        except ValueError:
+            print(f"error: {path} is outside the scan root {root} "
+                  "(pass --root)", file=sys.stderr)
+            return 2
+
+    try:
+        checkers = (ALL_CHECKERS if args.select is None
+                    else [checker_by_code(code.strip())
+                          for code in args.select.split(",") if code.strip()])
+    except KeyError as exc:
+        print(f"error: unknown checker code {exc.args[0]!r}",
+              file=sys.stderr)
+        return 2
+
+    files = discover_files(paths, root)
+    index = build_index(files)
+
+    if args.write_registry:
+        source = generate_registry_source(index.framework_sites,
+                                          index.framework_prefixes)
+        # regenerate the SCANNED tree's registry — never the installed
+        # package's (a user project without flashy_tpu/analysis/ must
+        # not silently clobber site-packages with an empty registry)
+        target = root / "flashy_tpu" / "analysis" / "registry.py"
+        if not target.parent.is_dir():
+            print(f"error: {root} has no flashy_tpu/analysis/ package; "
+                  "--write-registry only makes sense on a flashy_tpu "
+                  "source tree", file=sys.stderr)
+            return 2
+        target.write_text(source)
+        print(f"wrote {target} ({len(index.framework_sites)} sites, "
+              f"{len(index.framework_prefixes)} prefixes)")
+        # re-scan: the staleness finding must clear in the same run
+        files = discover_files(paths, root)
+        index = build_index(files)
+
+    findings, suppressed = run_checks(files, checkers, index)
+    by_rel = {f.rel: f for f in files}
+
+    baseline_path = args.baseline or root / DEFAULT_BASELINE_NAME
+    if args.write_baseline:
+        save_baseline(baseline_path, findings, by_rel)
+        print(f"wrote {baseline_path} ({len(findings)} grandfathered "
+              "findings)")
+        return 0
+
+    if args.no_baseline:
+        fresh = list(findings)
+    else:
+        fresh = new_findings(findings, by_rel, load_baseline(baseline_path))
+
+    if not args.quiet:
+        for finding in fresh:
+            print(finding.render())
+    grandfathered = len(findings) - len(fresh)
+    summary = (f"flashy_tpu.analysis: {len(files)} files, "
+               f"{len(fresh)} new finding(s)")
+    if grandfathered:
+        summary += f", {grandfathered} baselined"
+    if suppressed:
+        summary += f", {len(suppressed)} suppressed (noqa)"
+    print(summary)
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,74 @@
+# The ratchet. A linter introduced into a grown codebase either starts
+# at zero findings (after a cleanup sweep) or grandfathers what is left
+# — either way the gate must be "no NEW violations", not "zero
+# violations forever or the tool gets deleted". Fingerprints hash the
+# (file, code, stripped line text) triple, not line numbers, so
+# unrelated edits above a grandfathered finding do not break the build;
+# counts handle identical lines.
+"""Baseline files: fingerprinting and the no-new-violations gate."""
+from pathlib import Path
+import collections
+import json
+import typing as tp
+
+from .core import Finding, SourceFile
+
+__all__ = ["fingerprint", "load_baseline", "save_baseline", "new_findings",
+           "DEFAULT_BASELINE_NAME"]
+
+DEFAULT_BASELINE_NAME = ".analysis-baseline.json"
+_VERSION = 1
+
+
+def fingerprint(finding: Finding, line_text: str) -> str:
+    return f"{finding.path}::{finding.code}::{line_text}"
+
+
+def _counts(findings: tp.Sequence[Finding],
+            files: tp.Mapping[str, SourceFile]) -> tp.Counter:
+    counter: tp.Counter = collections.Counter()
+    for finding in findings:
+        file = files.get(finding.path)
+        line_text = file.line_text(finding.line) if file else ""
+        counter[fingerprint(finding, line_text)] += 1
+    return counter
+
+
+def load_baseline(path: Path) -> tp.Dict[str, int]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    if data.get("version") != _VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{data.get('version')!r}")
+    return {str(k): int(v) for k, v in data.get("entries", {}).items()}
+
+
+def save_baseline(path: Path, findings: tp.Sequence[Finding],
+                  files: tp.Mapping[str, SourceFile]) -> None:
+    entries = dict(sorted(_counts(findings, files).items()))
+    payload = {
+        "version": _VERSION,
+        "comment": ("flashy_tpu.analysis baseline — grandfathered "
+                    "findings; the gate is 'no NEW violations'. "
+                    "Regenerate with --write-baseline."),
+        "entries": entries,
+    }
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+
+
+def new_findings(findings: tp.Sequence[Finding],
+                 files: tp.Mapping[str, SourceFile],
+                 baseline: tp.Mapping[str, int]) -> tp.List[Finding]:
+    """Findings beyond the baselined count for their fingerprint."""
+    budget = dict(baseline)
+    fresh: tp.List[Finding] = []
+    for finding in findings:
+        file = files.get(finding.path)
+        line_text = file.line_text(finding.line) if file else ""
+        key = fingerprint(finding, line_text)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+        else:
+            fresh.append(finding)
+    return fresh

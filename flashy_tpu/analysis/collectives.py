@@ -1,0 +1,87 @@
+# FT005 — the `-start` accounting convention (PRs 1/4). Async
+# `-start`/`-done` collective pairs embed the input-shaped operand
+# alias(es) ahead of the result(s) in the start op's output tuple;
+# counting bytes or instructions off raw HLO text therefore DOUBLE
+# counts exactly the biggest transfers, and the same program reports
+# different traffic depending on whether XLA lowered sync (CPU) or
+# async (TPU). `parallel.accounting.collective_stats` implements the
+# sync-equivalent convention once; everything else must go through it.
+# This checker flags hand-rolled `-start` literals and `.count("all-
+# gather")`-style HLO scraping outside the accounting module.
+"""FT005 collective-accounting: hand-rolled async-collective counting."""
+import ast
+import typing as tp
+
+from .core import Checker, Finding, ProjectIndex, SourceFile, literal_str
+
+__all__ = ["CollectiveAccountingChecker", "COLLECTIVE_OPS"]
+
+# Kept in sync with parallel.accounting.COLLECTIVE_OPS by a unit test
+# (importing it here would drag jax into the stdlib-only linter).
+COLLECTIVE_OPS = ("ragged-all-to-all", "all-gather", "all-reduce",
+                  "reduce-scatter", "collective-permute", "all-to-all",
+                  "collective-broadcast")
+
+# The accounting module itself, its dedicated tests, and this package
+# (whose sources necessarily spell the op names) are the convention's
+# home turf.
+_ALLOWED_SUFFIXES = ("parallel/accounting.py",
+                     "tests/test_collective_accounting.py")
+
+
+def _allowed(rel: str) -> bool:
+    return (rel.startswith("flashy_tpu/analysis/")
+            or any(rel.endswith(s) for s in _ALLOWED_SUFFIXES))
+
+
+def _start_literal(value: str) -> tp.Optional[str]:
+    for op in COLLECTIVE_OPS:
+        if f"{op}-start" in value:
+            return f"{op}-start"
+    return None
+
+
+def _collective_literal(value: str) -> tp.Optional[str]:
+    for op in COLLECTIVE_OPS:
+        if op in value:
+            return op
+    return None
+
+
+class CollectiveAccountingChecker(Checker):
+    code = "FT005"
+    name = "collective-accounting"
+    explain = ("async `*-start` collectives must be counted through "
+               "parallel.accounting.collective_stats (sync-equivalent "
+               "operand/result convention), never by scraping HLO text")
+
+    def check(self, file: SourceFile,
+              index: ProjectIndex) -> tp.Iterable[Finding]:
+        if file.tree is None or _allowed(file.rel):
+            return
+        for node in ast.walk(file.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                op = _start_literal(node.value)
+                if op is not None:
+                    yield Finding(
+                        self.code, file.rel, node.lineno, node.col_offset,
+                        f"hand-rolled {op!r} handling — async start ops "
+                        "alias their operands into the output tuple, so "
+                        "raw matching double counts bytes vs the sync "
+                        "lowering",
+                        "use parallel.accounting.collective_stats / "
+                        "compare_collective_stats")
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in {"count", "findall"}
+                    and node.args):
+                value = literal_str(node.args[-1]) or literal_str(node.args[0])
+                op = _collective_literal(value) if value else None
+                if op is not None:
+                    yield Finding(
+                        self.code, file.rel, node.lineno, node.col_offset,
+                        f"counting {op!r} instructions by text search — "
+                        "this breaks on async lowering (-start/-done "
+                        "pairs) and on fused variants",
+                        "use parallel.accounting.collective_stats "
+                        "(count + sync-equivalent bytes per op)")

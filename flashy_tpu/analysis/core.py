@@ -1,0 +1,296 @@
+# The analyzer's spine: file discovery, the Finding record, `# flashy:
+# noqa[FTxxx]` suppression, and the one-pass project index shared by
+# every checker. Checkers are pure functions of (SourceFile,
+# ProjectIndex) -> findings; everything stateful (baseline, registry
+# generation) lives next door. Stdlib-only on purpose: the linter must
+# run (and be importable by CI) without jax or any device runtime.
+"""Core engine: source model, project index, suppression, runner."""
+from pathlib import Path
+import ast
+import dataclasses
+import re
+import typing as tp
+
+__all__ = [
+    "Finding", "SourceFile", "ProjectIndex", "Checker",
+    "discover_files", "load_file", "build_index", "run_checks",
+]
+
+# Directories never scanned. `analysis_fixtures` is the test corpus of
+# DELIBERATE violations — scanning it would make the live repo dirty by
+# construction.
+DEFAULT_EXCLUDED_DIRS = frozenset({
+    "__pycache__", ".git", ".claude", "build", "outputs", "dist",
+    "analysis_fixtures",
+})
+
+# `# flashy: noqa` (blanket) or `# flashy: noqa[FT001,FT004]`.
+_NOQA_RE = re.compile(
+    r"#\s*flashy:\s*noqa(?:\[(?P<codes>[A-Z0-9,\s]+)\])?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: a stable code, a location, and an autofix hint."""
+    code: str          # 'FT001'...
+    path: str          # root-relative posix path
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        text = f"{self.path}:{self.line}:{self.col + 1}: {self.code} {self.message}"
+        if self.hint:
+            text += f" [hint: {self.hint}]"
+        return text
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """A parsed source file plus its per-line suppressions."""
+    path: Path
+    rel: str                            # posix, relative to the scan root
+    text: str
+    lines: tp.List[str]
+    tree: tp.Optional[ast.Module]       # None on syntax error
+    noqa: tp.Dict[int, tp.Optional[tp.Set[str]]]  # line -> codes (None = all)
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def suppressed(self, finding: Finding) -> bool:
+        codes = self.noqa.get(finding.line, False)
+        if codes is False:
+            return False
+        return codes is None or finding.code in codes  # type: ignore[operator]
+
+
+class Checker:
+    """Base class: subclasses set `code`/`name`/`explain` and implement
+    `check`. One instance is reused across files, so keep them stateless
+    (per-run state belongs in ProjectIndex)."""
+
+    code: str = "FT000"
+    name: str = "base"
+    explain: str = ""
+
+    def check(self, file: "SourceFile",
+              index: "ProjectIndex") -> tp.Iterable[Finding]:
+        raise NotImplementedError
+
+
+def _parse_noqa(text: str) -> tp.Dict[int, tp.Optional[tp.Set[str]]]:
+    noqa: tp.Dict[int, tp.Optional[tp.Set[str]]] = {}
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if "flashy" not in line:
+            continue
+        m = _NOQA_RE.search(line)
+        if not m:
+            continue
+        raw = m.group("codes")
+        if raw is None:
+            noqa[lineno] = None
+            continue
+        codes = {c.strip() for c in raw.split(",") if c.strip()}
+        # `noqa[ ]` (no real codes) degrades to a blanket suppression
+        noqa[lineno] = codes or None
+    return noqa
+
+
+def load_file(path: Path, root: Path) -> SourceFile:
+    text = path.read_text(encoding="utf-8", errors="replace")
+    try:
+        tree: tp.Optional[ast.Module] = ast.parse(text, filename=str(path))
+    except SyntaxError:
+        tree = None
+    rel = path.resolve().relative_to(root.resolve()).as_posix()
+    return SourceFile(path=path, rel=rel, text=text,
+                      lines=text.splitlines(), tree=tree,
+                      noqa=_parse_noqa(text))
+
+
+def discover_files(paths: tp.Sequence[Path], root: Path,
+                   excluded_dirs: tp.FrozenSet[str] = DEFAULT_EXCLUDED_DIRS,
+                   ) -> tp.List[SourceFile]:
+    """Load every `.py` under `paths` (files or directories), skipping
+    `excluded_dirs` components; result sorted by relative path."""
+    seen: tp.Dict[str, SourceFile] = {}
+    for entry in paths:
+        entry = Path(entry)
+        if entry.is_file():
+            candidates: tp.Iterable[Path] = [entry]
+        else:
+            candidates = sorted(entry.rglob("*.py"))
+        for candidate in candidates:
+            try:
+                parts = candidate.resolve().relative_to(root.resolve()).parts
+            except ValueError:
+                continue  # symlink or path escaping the root: not ours
+            if any(part in excluded_dirs for part in parts):
+                continue
+            if candidate.suffix != ".py":
+                continue
+            loaded = load_file(candidate, root)
+            seen[loaded.rel] = loaded
+    return [seen[rel] for rel in sorted(seen)]
+
+
+# ----------------------------------------------------------------------
+# shared AST helpers
+# ----------------------------------------------------------------------
+def attr_chain(node: ast.AST) -> tp.Optional[tp.Tuple[str, ...]]:
+    """('jax', 'jit') for `jax.jit`, ('x',) for `x`; None otherwise."""
+    parts: tp.List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str:
+    """Trailing name of the callee ('' when not a name/attribute)."""
+    chain = attr_chain(node.func)
+    return chain[-1] if chain else ""
+
+
+def literal_str(node: ast.AST) -> tp.Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_prefix(node: ast.AST) -> tp.Optional[str]:
+    """Leading literal text of an f-string, '' if it starts with a hole."""
+    if not isinstance(node, ast.JoinedStr):
+        return None
+    prefix = ""
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            prefix += part.value
+        else:
+            break
+    return prefix
+
+
+# ----------------------------------------------------------------------
+# project index
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class ProjectIndex:
+    """One pass of whole-project facts the per-file checkers consult.
+
+    * `stateful_classes`: classes defining BOTH state_dict and
+      load_state_dict anywhere in the scanned set (the FT004 knowledge
+      base — a solver attribute holding one of these must be
+      register_stateful'd to survive commit()).
+    * `declared_sites` / `declared_prefixes`: `fault_point(...)` site
+      literals per file (f-strings contribute their literal prefix);
+      tests may declare purely local sites by calling fault_point
+      themselves.
+    * `framework_sites` / `framework_prefixes`: the union over files
+      beneath `flashy_tpu/` — the ground truth the generated registry
+      must match.
+    """
+    files: tp.List[SourceFile]
+    stateful_classes: tp.Set[str] = dataclasses.field(default_factory=set)
+    declared_sites: tp.Dict[str, tp.Set[str]] = dataclasses.field(
+        default_factory=dict)
+    declared_prefixes: tp.Dict[str, tp.Set[str]] = dataclasses.field(
+        default_factory=dict)
+    framework_sites: tp.Set[str] = dataclasses.field(default_factory=set)
+    framework_prefixes: tp.Set[str] = dataclasses.field(default_factory=set)
+
+    def has_framework_files(self) -> bool:
+        return any(f.rel.startswith("flashy_tpu/") for f in self.files)
+
+
+def _module_str_constants(tree: ast.Module) -> tp.Dict[str, str]:
+    """Module/class-level `NAME = "literal"` bindings (site constants)."""
+    out: tp.Dict[str, str] = {}
+    nodes: tp.List[ast.AST] = list(tree.body)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            nodes.extend(node.body)
+    for node in nodes:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            value = literal_str(node.value)
+            if value is not None:
+                out[node.targets[0].id] = value
+    return out
+
+
+def extract_fault_sites(file: SourceFile,
+                        ) -> tp.Tuple[tp.Set[str], tp.Set[str]]:
+    """(exact sites, f-string prefixes) declared by `fault_point` calls."""
+    sites: tp.Set[str] = set()
+    prefixes: tp.Set[str] = set()
+    if file.tree is None:
+        return sites, prefixes
+    constants = _module_str_constants(file.tree)
+    for node in ast.walk(file.tree):
+        if not isinstance(node, ast.Call) or call_name(node) != "fault_point":
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        value = literal_str(arg)
+        if value is not None:
+            sites.add(value)
+            continue
+        if isinstance(arg, ast.Name) and arg.id in constants:
+            sites.add(constants[arg.id])
+            continue
+        prefix = fstring_prefix(arg)
+        if prefix:  # '' would match everything — unverifiable, skip
+            prefixes.add(prefix)
+    return sites, prefixes
+
+
+def _defines_state_protocol(node: ast.ClassDef) -> bool:
+    methods = {item.name for item in node.body
+               if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    return "state_dict" in methods and "load_state_dict" in methods
+
+
+def build_index(files: tp.Sequence[SourceFile]) -> ProjectIndex:
+    index = ProjectIndex(files=list(files))
+    for file in files:
+        if file.tree is None:
+            continue
+        for node in ast.walk(file.tree):
+            if isinstance(node, ast.ClassDef) and _defines_state_protocol(node):
+                index.stateful_classes.add(node.name)
+        sites, prefixes = extract_fault_sites(file)
+        index.declared_sites[file.rel] = sites
+        index.declared_prefixes[file.rel] = prefixes
+        if file.rel.startswith("flashy_tpu/"):
+            index.framework_sites |= sites
+            index.framework_prefixes |= prefixes
+    return index
+
+
+def run_checks(files: tp.Sequence[SourceFile],
+               checkers: tp.Sequence[Checker],
+               index: tp.Optional[ProjectIndex] = None,
+               ) -> tp.Tuple[tp.List[Finding], tp.List[Finding]]:
+    """Run `checkers` over `files`; returns (active, suppressed) findings,
+    each sorted by (path, line, code)."""
+    index = index if index is not None else build_index(files)
+    active: tp.List[Finding] = []
+    suppressed: tp.List[Finding] = []
+    by_rel = {f.rel: f for f in files}
+    for file in files:
+        for checker in checkers:
+            for finding in checker.check(file, index):
+                owner = by_rel.get(finding.path, file)
+                (suppressed if owner.suppressed(finding) else active
+                 ).append(finding)
+    key = lambda f: (f.path, f.line, f.code, f.col)  # noqa: E731
+    return sorted(active, key=key), sorted(suppressed, key=key)

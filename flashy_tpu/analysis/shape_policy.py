@@ -1,0 +1,114 @@
+# FT002 — the serving/datapipe shape discipline ("liveness is an
+# input, never a shape"; PRs 2/7/8). Every compiled executable in the
+# serve and datapipe layers is shaped by STATIC capacity constants
+# ([S, max_seq_len] slots, [B, max_len] packed batches, power-of-two
+# prefill buckets); runtime quantities — how many requests are live,
+# how long this prompt is — enter as DATA (masks, index vectors,
+# jnp.int32 scalars). The moment a device-array shape is derived from
+# len(runtime_data) or runtime `.shape`, every new length compiles a
+# new executable and the zero-recompile serving gates are forfeit.
+"""FT002 shape-policy: runtime-data-derived shapes in serve/ and datapipe/."""
+import ast
+import typing as tp
+
+from .core import Checker, Finding, ProjectIndex, SourceFile, attr_chain
+
+__all__ = ["ShapePolicyChecker"]
+
+# device-array constructors whose first argument is a SHAPE (host-side
+# np.zeros(len(x)) padding buffers are fine — only jnp shapes compile)
+_SHAPE_CONSTRUCTORS = {"zeros", "ones", "empty", "full", "arange"}
+_JNP_ROOTS = {"jnp"}
+
+
+def _scoped(rel: str) -> bool:
+    parts = rel.split("/")[:-1]
+    return "serve" in parts or "datapipe" in parts
+
+
+def _runtime_length_expr(node: ast.AST) -> tp.Optional[str]:
+    """A description when `node` contains len(runtime)/runtime.shape."""
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len" and sub.args
+                and not isinstance(sub.args[0], (ast.Constant, ast.Tuple,
+                                                 ast.List))):
+            return "len(...)"
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return ".shape"
+    return None
+
+
+def _jit_bound_names(tree: ast.Module) -> tp.Set[str]:
+    """Names (incl. `self._x` attr tails) assigned from jax.jit(...)."""
+    names: tp.Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        chain = attr_chain(value.func)
+        if not chain or chain[-1] not in {"jit", "pjit"}:
+            continue
+        for target in node.targets:
+            tchain = attr_chain(target)
+            if tchain:
+                names.add(tchain[-1])
+    return names
+
+
+class ShapePolicyChecker(Checker):
+    code = "FT002"
+    name = "shape-policy"
+    explain = ("serve/ and datapipe/ executables must be shaped by "
+               "static capacity constants; len()/.shape of runtime data "
+               "in a jnp constructor shape or fed raw into a jitted "
+               "callable recompiles per length")
+
+    def check(self, file: SourceFile,
+              index: ProjectIndex) -> tp.Iterable[Finding]:
+        if file.tree is None or not _scoped(file.rel):
+            return
+        jit_names = _jit_bound_names(file.tree)
+        for node in ast.walk(file.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain:
+                continue
+            if (chain[-1] in _SHAPE_CONSTRUCTORS and len(chain) >= 2
+                    and chain[-2] in _JNP_ROOTS and node.args):
+                culprit = _runtime_length_expr(node.args[0])
+                if culprit is not None:
+                    yield Finding(
+                        self.code, file.rel, node.lineno, node.col_offset,
+                        f"jnp.{chain[-1]} shape derived from {culprit} of "
+                        "runtime data — every new length compiles a new "
+                        "executable",
+                        "allocate at static capacity (slots/buckets/"
+                        "max_len) and pass the live length as data "
+                        "(mask or jnp.int32 input)")
+            elif chain[-1] in jit_names:
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    if isinstance(arg, ast.Call):
+                        if (isinstance(arg.func, ast.Name)
+                                and arg.func.id == "len"):
+                            yield self._raw_length(file, arg, "len(...)")
+                    elif (isinstance(arg, ast.Attribute)
+                            and arg.attr == "shape"):
+                        yield self._raw_length(file, arg, ".shape")
+                    elif (isinstance(arg, ast.Subscript)
+                            and isinstance(arg.value, ast.Attribute)
+                            and arg.value.attr == "shape"):
+                        yield self._raw_length(file, arg, ".shape[...]")
+
+    def _raw_length(self, file: SourceFile, node: ast.AST,
+                    what: str) -> Finding:
+        return Finding(
+            self.code, file.rel,
+            node.lineno, node.col_offset,  # type: ignore[attr-defined]
+            f"raw {what} of runtime data passed into a jitted callable "
+            "— lengths must cross the jit boundary as device data",
+            "wrap it (jnp.int32(length)) and index/mask on device")

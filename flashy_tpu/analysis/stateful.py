@@ -1,0 +1,100 @@
+# FT004 — the Solver contract the paper leads with: "checkpointing
+# with automatic tracking of stateful solver attributes". The tracking
+# is automatic only after `register_stateful(...)`; an EMA, a datapipe
+# cursor or any other state_dict-bearing object assigned to a solver
+# attribute and never registered silently does not survive
+# commit()/restore() — training resumes with a fresh object and nothing
+# errors. The project index already knows every class in the repo that
+# implements the state protocol, so this is statically checkable.
+"""FT004 stateful-attr: unregistered stateful attributes on solvers."""
+import ast
+import typing as tp
+
+from .core import Checker, Finding, ProjectIndex, SourceFile, attr_chain
+
+__all__ = ["StatefulAttrChecker"]
+
+# Infrastructure the solver base itself owns — registering these would
+# be circular (StateManager IS the registry).
+_EXEMPT_CLASSES = {"StateManager", "AttributeWrapper"}
+_EXEMPT_ATTRS = {"stateful"}
+
+
+def _is_solver_class(node: ast.ClassDef) -> bool:
+    for base in node.bases:
+        chain = attr_chain(base)
+        if chain and chain[-1].endswith("Solver"):
+            return True
+    return False
+
+
+def _registered_names(node: ast.ClassDef) -> tp.Optional[tp.Set[str]]:
+    """First segments of register_stateful literals + _state_attrs
+    entries; None when registration is dynamic (give up, stay quiet)."""
+    registered: tp.Set[str] = set()
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "register_stateful"):
+            for arg in sub.args:
+                if isinstance(arg, ast.Starred):
+                    return None
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    return None
+                registered.add(arg.value.split(".")[0])
+        elif isinstance(sub, ast.Assign):
+            for target in sub.targets:
+                if isinstance(target, ast.Name) and target.id == "_state_attrs":
+                    if isinstance(sub.value, (ast.List, ast.Tuple, ast.Set)):
+                        for element in sub.value.elts:
+                            if (isinstance(element, ast.Constant)
+                                    and isinstance(element.value, str)):
+                                registered.add(element.value.split(".")[0])
+                    else:
+                        return None
+    return registered
+
+
+class StatefulAttrChecker(Checker):
+    code = "FT004"
+    name = "stateful-attr"
+    explain = ("solver attributes holding state_dict/load_state_dict "
+               "objects must be register_stateful'd (or listed in "
+               "_state_attrs) to survive commit()/restore()")
+
+    def check(self, file: SourceFile,
+              index: ProjectIndex) -> tp.Iterable[Finding]:
+        if file.tree is None:
+            return
+        stateful = index.stateful_classes - _EXEMPT_CLASSES
+        for node in ast.walk(file.tree):
+            if not isinstance(node, ast.ClassDef) or not _is_solver_class(node):
+                continue
+            registered = _registered_names(node)
+            if registered is None:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for target in sub.targets:
+                    if not (isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"):
+                        continue
+                    attr = target.attr
+                    if attr in _EXEMPT_ATTRS or attr in registered:
+                        continue
+                    if not isinstance(sub.value, ast.Call):
+                        continue
+                    chain = attr_chain(sub.value.func)
+                    cls = chain[-1] if chain else ""
+                    if cls in stateful:
+                        yield Finding(
+                            self.code, file.rel,
+                            sub.lineno, sub.col_offset,
+                            f"solver {node.name!r} assigns stateful "
+                            f"{cls} to self.{attr} without registering "
+                            "it — it will silently not survive "
+                            "commit()/restore()",
+                            f'add self.register_stateful("{attr}") in '
+                            "__init__ (or list it in _state_attrs)")

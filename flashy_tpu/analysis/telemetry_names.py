@@ -1,0 +1,67 @@
+# FT006 — telemetry track naming. Perfetto groups counter/instant
+# tracks by the `sub/name` path (PR 1's convention: `serve/queue_depth`,
+# `datapipe/prefetch`, `compile_cache/miss/<fn>`), and
+# `python -m flashy_tpu.info` aggregates by the same prefix. A track
+# named outside the convention ("queueDepth", "Serve Queue") renders as
+# an orphan row in the trace UI and is invisible to info's rollups —
+# telemetry that exists but cannot be found.
+"""FT006 telemetry-track naming: counter/instant literals must be sub/name."""
+import ast
+import re
+import typing as tp
+
+from .core import (Checker, Finding, ProjectIndex, SourceFile,
+                   fstring_prefix, literal_str)
+
+__all__ = ["TelemetryNameChecker", "TRACK_RE"]
+
+_TRACK_METHODS = {"counter", "instant"}
+# lowercase path segments separated by '/': `serve/queue_depth`,
+# `compile_cache/miss/decode`. Dots and dashes allowed inside segments.
+TRACK_RE = re.compile(r"^[a-z0-9_.-]+(/[a-z0-9_.-]+)+$")
+_SEGMENT_RE = re.compile(r"^[a-z0-9_.-]+(/[a-z0-9_.-]*)*$")
+
+
+class TelemetryNameChecker(Checker):
+    code = "FT006"
+    name = "telemetry-track"
+    explain = ("tracer.counter/instant track literals must follow the "
+               "`sub/name` convention (lowercase, '/'-separated) that "
+               "Perfetto grouping and `flashy_tpu.info` rollups consume")
+
+    def check(self, file: SourceFile,
+              index: ProjectIndex) -> tp.Iterable[Finding]:
+        if file.tree is None:
+            return
+        for node in ast.walk(file.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _TRACK_METHODS
+                    and node.args):
+                continue
+            arg = node.args[0]
+            value = literal_str(arg)
+            if value is not None:
+                if not TRACK_RE.match(value):
+                    yield self._finding(file, node, value)
+                continue
+            prefix = fstring_prefix(arg)
+            if not prefix:
+                # Name/expr args and fully-dynamic f-strings
+                # (f"{sub}/{name}") carry no judgeable literal: skip,
+                # same as a variable track name
+                continue
+            # f-string: the literal prefix must already be on-convention
+            # (contain the sub/ separator with valid leading segments)
+            if "/" not in prefix or not _SEGMENT_RE.match(prefix):
+                yield self._finding(file, node, prefix + "{...}")
+
+    def _finding(self, file: SourceFile, node: ast.Call,
+                 value: str) -> Finding:
+        return Finding(
+            self.code, file.rel, node.lineno, node.col_offset,
+            f"telemetry track {value!r} does not follow the `sub/name` "
+            "convention — it will not group in Perfetto nor roll up in "
+            "`flashy_tpu.info`",
+            "name it '<subsystem>/<metric>' in lowercase, e.g. "
+            "'serve/queue_depth'")

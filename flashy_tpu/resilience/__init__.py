@@ -38,8 +38,9 @@ from .integrity import (  # noqa
 )
 from .retry import backoff_delay, call_with_retry, retry  # noqa
 from .chaos import (  # noqa
-    FaultInjector, InjectedFault, corrupt_active_slot, corrupt_file,
-    fault_point, get_injector, install, stall_heartbeat, uninstall,
+    FaultInjector, InjectedFault, UnfiredFaultRules, corrupt_active_slot,
+    corrupt_file, fault_point, get_injector, install, stall_heartbeat,
+    uninstall,
 )
 from .hang import EXIT_HUNG, HangWatchdog  # noqa
 
@@ -51,7 +52,8 @@ __all__ = [
     "write_manifest", "write_sidecar", "verify_slot", "verify_file",
     "verify_checkpoint",
     "retry", "call_with_retry", "backoff_delay",
-    "FaultInjector", "InjectedFault", "install", "uninstall", "get_injector",
+    "FaultInjector", "InjectedFault", "UnfiredFaultRules", "install",
+    "uninstall", "get_injector",
     "fault_point", "corrupt_file", "corrupt_active_slot", "stall_heartbeat",
     "HangWatchdog",
 ]

@@ -30,6 +30,16 @@ class InjectedFault(OSError):
     """
 
 
+class UnfiredFaultRules(RuntimeError):
+    """Raised by strict `uninstall()` when armed rules never fired.
+
+    A rule that never fires proves nothing — worse, it makes the chaos
+    test vacuously green (a typo'd site name or an occurrence index
+    past the run's length both look exactly like 'recovery worked').
+    This is the runtime complement of the FT003 static fault-site check.
+    """
+
+
 @dataclasses.dataclass
 class _Rule:
     site: str
@@ -37,9 +47,17 @@ class _Rule:
     times: int                # consecutive occurrences it stays armed for
     action: tp.Callable[[], None]
     kind: str                 # 'fail' | 'preempt' | 'act' (for the log)
+    fired_count: int = 0      # occurrences at which this rule triggered
 
     def armed_for(self, call: int) -> bool:
         return self.first_call <= call < self.first_call + self.times
+
+    def describe(self, seen: int) -> str:
+        return (f"{self.kind}@{self.site!r} (armed for occurrence "
+                f"{self.first_call}"
+                + (f"..{self.first_call + self.times - 1}"
+                   if self.times > 1 else "")
+                + f", site seen {seen} time(s))")
 
 
 class FaultInjector:
@@ -48,9 +66,15 @@ class FaultInjector:
     `counts` tallies every occurrence of every site (whether or not a
     rule fired), `fired` records each triggered fault — the evidence a
     chaos drill checks to assert its faults were actually exercised.
+
+    With `strict=True` (what the chaos drills use), `uninstall()`
+    raises :class:`UnfiredFaultRules` if any armed rule never fired;
+    non-strict injectors only WARN. Either way a drill cannot silently
+    pass with its faults unexercised.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, strict: bool = False) -> None:
+        self.strict = strict
         self.counts: tp.Dict[str, int] = {}
         self.fired: tp.List[tp.Dict[str, tp.Any]] = []
         self._rules: tp.List[_Rule] = []
@@ -105,6 +129,7 @@ class FaultInjector:
             if rule.site == site and rule.armed_for(call):
                 self.fired.append({"site": site, "call": call,
                                    "kind": rule.kind, **context})
+                rule.fired_count += 1
                 logger.info("chaos: firing %s fault at %s (occurrence %d)",
                             rule.kind, site, call)
                 rule.action()
@@ -116,25 +141,63 @@ class FaultInjector:
                    if (site is None or f["site"] == site)
                    and (kind is None or f["kind"] == kind))
 
+    def unfired_rules(self) -> tp.List[str]:
+        """Descriptions of armed rules that never fired — each one a
+        fault the test THINKS it injected but did not."""
+        return [rule.describe(self.counts.get(rule.site, 0))
+                for rule in self._rules if rule.fired_count == 0]
+
+    def verify_fired(self) -> None:
+        """Raise :class:`UnfiredFaultRules` if any armed rule never
+        fired (whatever `strict` says — for explicit mid-drill gates)."""
+        unfired = self.unfired_rules()
+        if unfired:
+            raise UnfiredFaultRules(
+                "fault rules armed but never fired — the faults they "
+                "were meant to inject never happened:\n  "
+                + "\n  ".join(unfired))
+
 
 _injector: tp.Optional[FaultInjector] = None
 
 
-def install(injector: tp.Optional[FaultInjector] = None) -> FaultInjector:
+def install(injector: tp.Optional[FaultInjector] = None, *,
+            strict: bool = False) -> FaultInjector:
     """Install a process-wide FaultInjector (building one if not given).
 
     Every framework `fault_point` site starts consulting it. Tests
     should pair this with `uninstall()` (or use it via fixture teardown).
+    `strict=True` makes the eventual `uninstall()` raise on rules that
+    never fired (see :class:`UnfiredFaultRules`).
     """
     global _injector
-    _injector = injector or FaultInjector()
+    _injector = injector or FaultInjector(strict=strict)
+    if strict:
+        # honor strict=True for a pre-built injector too — silently
+        # keeping it lax would re-open the vacuously-green-drill hole
+        _injector.strict = True
     return _injector
 
 
-def uninstall() -> None:
-    """Remove the process-wide injector; all sites become no-ops again."""
+def uninstall(verify: tp.Optional[bool] = None) -> None:
+    """Remove the process-wide injector; all sites become no-ops again.
+
+    If any armed rule never fired, WARNs — or raises
+    :class:`UnfiredFaultRules` when the injector was installed with
+    `strict=True` (or `verify=True` forces it). Pass `verify=False` on
+    error-cleanup paths where a raise would mask the original failure.
+    """
     global _injector
-    _injector = None
+    injector, _injector = _injector, None
+    if injector is None or verify is False:
+        return
+    unfired = injector.unfired_rules()
+    if not unfired:
+        return
+    if verify or injector.strict:
+        injector.verify_fired()
+    logger.warning("chaos: uninstalling with %d rule(s) that never "
+                   "fired:\n  %s", len(unfired), "\n  ".join(unfired))
 
 
 def get_injector() -> tp.Optional[FaultInjector]:

@@ -1,0 +1,30 @@
+# FT001 fixture: every host-boundary crossing the trace-leak checker
+# must flag inside code reachable from a jit entry point.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def helper(x):
+    # reachable from `step` below -> traced; .item() is a host sync
+    return x.sum().item()                              # FT001 (.item)
+
+
+def step(params, batch):
+    lr = float(params["lr"])                           # FT001 (float on param)
+    if jnp.any(batch > 0):                             # FT001 (branch on traced)
+        batch = batch * lr
+    host = np.asarray(batch)                           # FT001 (np.asarray)
+    flat = batch.tolist()                              # FT001 (.tolist)
+    batch.block_until_ready()                          # FT001 (sync in jit)
+    return helper(batch), host, flat
+
+
+train_step = jax.jit(step)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def decorated(n, x):
+    return int(x)                                      # FT001 (int on param)

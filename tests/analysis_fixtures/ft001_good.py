@@ -1,0 +1,25 @@
+# FT001 fixture: host conversions OUTSIDE traced code (and static
+# trace-time scalars inside it) are all legal — zero findings expected.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_step(scale, clip):
+    # `scale`/`clip` are parameters of a NON-traced builder: trace-time
+    # constants for the closure, so int()/float() on them is static.
+    factor = float(scale)
+
+    def step(params, batch):
+        capacity = int(scale * 4)          # static arithmetic: fine
+        if clip:                           # static flag branch: fine
+            batch = jnp.clip(batch, -1, 1)
+        return batch * factor + capacity
+
+    return jax.jit(step)
+
+
+def host_loop(loader):
+    # not reachable from any jit entry: host conversions are the point
+    for batch in loader:
+        yield int(batch.shape[0]), np.asarray(batch), batch.tolist()

@@ -1,0 +1,9 @@
+# FT003 fixture: armed sites no fault_point ever fires. 'ckpt.wrtie'
+# is the canonical typo (the checker should suggest 'ckpt.write');
+# 'totally.unknown' has no close match at all.
+
+
+def arm(injector):
+    injector.fail_at("ckpt.wrtie", call=1)             # FT003 (typo)
+    injector.preempt_at("totally.unknown", call=2)     # FT003 (unknown)
+    injector.act_at("drill.stepp", call=1, action=id)  # FT003 (typo)

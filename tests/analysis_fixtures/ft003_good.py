@@ -1,0 +1,15 @@
+# FT003 fixture: registered framework sites, prefix-covered dynamic
+# sites, and a purely local site declared by calling fault_point in
+# this very file (how tests exercise injector plumbing) — no findings.
+from flashy_tpu.resilience import fault_point
+
+
+def local_site():
+    fault_point("fixture.local", step=1)
+
+
+def arm(injector):
+    injector.fail_at("ckpt.write", call=1)        # registered: fine
+    injector.fail_at("logger.wandb", call=1)      # prefix 'logger.': fine
+    injector.preempt_at("drill.step", call=2)     # registered: fine
+    injector.fail_at("fixture.local", call=1)     # declared above: fine

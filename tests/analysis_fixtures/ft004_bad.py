@@ -1,0 +1,20 @@
+# FT004 fixture: a solver assigning state_dict-bearing objects without
+# registering them — the state silently does not survive a commit.
+
+
+class Shadow:
+    def state_dict(self):
+        return {}
+
+    def load_state_dict(self, state):
+        pass
+
+
+class LeakySolver(BaseSolver):  # noqa: F821 — never imported, only parsed
+    def __init__(self):
+        super().__init__()
+        self.ema = Shadow()                            # FT004 (unregistered)
+        self.register_stateful("history")
+
+    def prepare(self):
+        self.pipe = Shadow()                           # FT004 (unregistered)

@@ -1,0 +1,43 @@
+# FT004 fixture: every blessed registration spelling — literal
+# register_stateful, dotted paths, _state_attrs, and the dynamic-
+# registration escape hatch (non-literal args -> the checker stays
+# quiet rather than guessing).
+
+
+class Shadow:
+    def state_dict(self):
+        return {}
+
+    def load_state_dict(self, state):
+        pass
+
+
+class RegisteredSolver(BaseSolver):  # noqa: F821 — only parsed
+    def __init__(self):
+        super().__init__()
+        self.ema = Shadow()
+        self.register_stateful("ema")
+
+    def prepare(self):
+        self.pipe = Shadow()
+        self.register_stateful("pipe.cursor")   # dotted: first segment
+
+
+class ListedSolver(BaseSolver):  # noqa: F821 — only parsed
+    _state_attrs = ["ema"]
+
+    def __init__(self):
+        super().__init__()
+        self.ema = Shadow()
+
+
+class DynamicSolver(BaseSolver):  # noqa: F821 — only parsed
+    def __init__(self, names):
+        super().__init__()
+        self.ema = Shadow()
+        self.register_stateful(*names)          # dynamic: checker skips
+
+
+class NotASolver:
+    def __init__(self):
+        self.ema = Shadow()                     # not a solver: fine

@@ -1,0 +1,8 @@
+# FT005 fixture: hand-rolled async-collective accounting — both the
+# raw '-start' literal and text-count scraping of compiled HLO.
+
+
+def count_gathers(compiled):
+    text = compiled.as_text()
+    starts = "all-gather-start"                        # FT005 (literal)
+    return text.count("reduce-scatter")                # FT005 (.count scrape)

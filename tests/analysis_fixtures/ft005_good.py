@@ -1,0 +1,10 @@
+# FT005 fixture: the blessed path — collectives counted through the
+# accounting module's sync-equivalent convention. Zero findings.
+from flashy_tpu.parallel.accounting import (collective_stats,
+                                            compare_collective_stats)
+
+
+def comms_delta(compiled, baseline):
+    stats = collective_stats(compiled)
+    gathers = stats["all-gather"]["count"]
+    return gathers, compare_collective_stats(compiled, baseline)

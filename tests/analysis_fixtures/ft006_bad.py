@@ -1,0 +1,10 @@
+# FT006 fixture: telemetry tracks off the `sub/name` convention —
+# flat names, capitalized names, and f-strings whose literal prefix
+# never establishes the sub/ segment.
+
+
+def emit(tracer, depth, name):
+    tracer.counter("queueDepth", depth=depth)          # FT006 (no sub/)
+    tracer.counter("Serve/Queue", depth=depth)         # FT006 (uppercase)
+    tracer.instant("marker", note="hi")                # FT006 (flat)
+    tracer.instant(f"miss {name}", n=1)                # FT006 (bad f-prefix)

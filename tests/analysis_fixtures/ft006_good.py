@@ -1,0 +1,14 @@
+# FT006 fixture: on-convention track names — plain sub/name paths,
+# deeper paths, f-strings with a conventional literal prefix, and
+# non-literal names (constants) the checker cannot and does not judge.
+TRACK = "serve/queue_depth"
+
+
+def emit(tracer, depth, name, sub):
+    tracer.counter("serve/queue_depth", depth=depth)
+    tracer.counter("datapipe/prefetch", queue=depth)
+    tracer.instant(f"compile_cache/miss/{name}", n=1)
+    tracer.counter(TRACK, depth=depth)    # non-literal: not judged
+    tracer.counter(f"{sub}/{name}", n=1)  # fully dynamic: not judged
+    counter = tracer.counter              # bare attribute: not a call
+    return counter

@@ -1,0 +1,23 @@
+# FT002 fixture (lives under serve/ because the checker is
+# path-scoped): runtime-data-derived shapes feeding compiled code.
+import jax
+import jax.numpy as jnp
+
+
+def _build(fn):
+    return fn
+
+
+decode = jax.jit(lambda c, t: (c, t))
+
+
+def admit(requests, cache):
+    batch = jnp.zeros((len(requests), 128))            # FT002 (len shape)
+    mask = jnp.ones(cache.shape)                       # FT002 (.shape shape)
+    return batch, mask
+
+
+def hot_step(prompt, cache):
+    out = decode(cache, len(prompt))                   # FT002 (raw len arg)
+    out = decode(cache, prompt.shape[0])               # FT002 (raw .shape arg)
+    return out

@@ -1,0 +1,22 @@
+# FT002 fixture: the blessed spellings — static capacity constants,
+# lengths crossing the jit boundary as device data, and host-side numpy
+# scratch buffers (np, not jnp) sized by runtime data.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_SEQ_LEN = 256
+SLOTS = 8
+
+decode = jax.jit(lambda c, t, n: (c, t, n))
+
+
+def admit(requests, prompt):
+    batch = jnp.zeros((SLOTS, MAX_SEQ_LEN))            # static capacity: fine
+    padded = np.zeros(len(prompt) + 7)                 # host numpy: fine
+    return batch, padded
+
+
+def hot_step(prompt, cache):
+    # length enters as DATA — the documented convention
+    return decode(cache, jnp.asarray(prompt), jnp.int32(len(prompt)))

@@ -1,0 +1,20 @@
+# Suppression fixture: the same violations as elsewhere, silenced with
+# `# flashy: noqa[...]` — scoped, multi-code, and blanket forms. The
+# one line WITHOUT a matching code must still be reported.
+import jax
+import jax.numpy as jnp
+
+
+def step(params, batch):
+    lr = float(params["lr"])  # flashy: noqa[FT001]
+    check = batch.sum().item()  # flashy: noqa[FT001,FT999]
+    loss = batch.tolist()  # flashy: noqa
+    leak = batch.mean().item()  # flashy: noqa[FT006] — wrong code: reported
+    return lr, check, loss, leak
+
+
+train = jax.jit(step)
+
+
+def emit(tracer):
+    tracer.counter("BadName", n=1)  # flashy: noqa[FT006]

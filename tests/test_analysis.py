@@ -1,0 +1,388 @@
+# Tests for flashy_tpu.analysis: fixture corpus per checker, noqa +
+# baseline round-trips, the generated fault-site registry, the CLI
+# gate, and — the one that keeps everyone honest — the live repo being
+# clean against the committed baseline. Runtime strict-injector tests
+# (the FT003 complement) live at the bottom.
+#
+# NOTE this file is itself scanned by the live-repo run, so deliberate
+# violations only ever appear inside string literals or fixture files —
+# never as real AST call/constant patterns (e.g. '-start' collective
+# literals are built by concatenation).
+from pathlib import Path
+import json
+import logging
+import shutil
+
+import pytest
+
+from flashy_tpu import analysis
+from flashy_tpu.analysis import __main__ as cli
+from flashy_tpu.analysis import registry
+from flashy_tpu.analysis.baseline import (load_baseline, new_findings,
+                                          save_baseline)
+from flashy_tpu.analysis.collectives import COLLECTIVE_OPS
+from flashy_tpu.analysis.core import build_index, discover_files, run_checks
+from flashy_tpu.analysis.fault_sites import generate_registry_source
+from flashy_tpu.resilience import chaos
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "analysis_fixtures"
+
+
+def analyze_fixtures(select=None, root=FIXTURES):
+    return analysis.analyze([root], root, select=select)
+
+
+def codes_for(findings, rel):
+    return [f.code for f in findings if f.path == rel]
+
+
+# ----------------------------------------------------------------------
+# per-checker fixture corpus
+# ----------------------------------------------------------------------
+def test_ft001_bad_fixture():
+    findings = analyze_fixtures(select=["FT001"])
+    bad = [f for f in findings if f.path == "ft001_bad.py"]
+    assert len(bad) == 7
+    messages = " | ".join(f.message for f in bad)
+    for needle in (".item()", "float()", "branch", "np.asarray",
+                   ".tolist()", ".block_until_ready()", "int()"):
+        assert needle in messages
+    # reachability: helper() is flagged because step() references it
+    assert any("helper" in f.message for f in bad)
+
+
+def test_ft001_good_fixture_clean():
+    findings = analyze_fixtures(select=["FT001"])
+    assert codes_for(findings, "ft001_good.py") == []
+
+
+def test_ft001_name_collision_host_method_not_traced(tmp_path):
+    # the DecodeEngine pattern: a host METHOD named like the nested
+    # function its builder hands to jax.jit must not inherit traced-ness
+    (tmp_path / "engine.py").write_text(
+        "import jax\n"
+        "import numpy as np\n"
+        "class Engine:\n"
+        "    def _build(self):\n"
+        "        def prefill(cache, t):\n"
+        "            return cache, t\n"
+        "        return jax.jit(prefill)\n"
+        "    def prefill(self, prompt):\n"
+        "        prompt = np.asarray(prompt)\n"
+        "        return int(prompt.size)\n")
+    assert analysis.analyze([tmp_path], tmp_path, select=["FT001"]) == []
+
+
+def test_ft001_hot_path_block_until_ready(tmp_path):
+    serve = tmp_path / "serve"
+    serve.mkdir()
+    (serve / "loop.py").write_text(
+        "def warmup(engine):\n"
+        "    engine.step().block_until_ready()\n"
+        "def hot(engine):\n"
+        "    engine.step().block_until_ready()\n")
+    findings = analysis.analyze([tmp_path], tmp_path, select=["FT001"])
+    assert len(findings) == 1
+    assert findings[0].line == 4  # warmup() is exempt, hot() is not
+
+
+def test_ft002_fixtures():
+    findings = analyze_fixtures(select=["FT002"])
+    assert len(codes_for(findings, "serve/ft002_bad.py")) == 4
+    assert codes_for(findings, "serve/ft002_good.py") == []
+
+
+def test_ft002_only_scoped_paths(tmp_path):
+    # the same bad pattern OUTSIDE serve//datapipe/ is not this
+    # checker's business (training code shapes by config all the time)
+    (tmp_path / "train.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def f(xs):\n"
+        "    return jnp.zeros((len(xs), 4))\n")
+    assert analysis.analyze([tmp_path], tmp_path, select=["FT002"]) == []
+
+
+def test_ft003_bad_fixture_typo_hints():
+    findings = analyze_fixtures(select=["FT003"])
+    bad = [f for f in findings if f.path == "ft003_bad.py"]
+    assert len(bad) == 3
+    by_line = {f.line: f for f in bad}
+    assert "ckpt.write" in by_line[7].hint       # typo -> closest match
+    assert "drill.step" in by_line[9].hint
+    assert "fault_point" in by_line[8].hint      # no close match
+
+
+def test_ft003_good_fixture_clean():
+    findings = analyze_fixtures(select=["FT003"])
+    assert codes_for(findings, "ft003_good.py") == []
+
+
+def test_ft004_fixtures():
+    findings = analyze_fixtures(select=["FT004"])
+    bad = codes_for(findings, "ft004_bad.py")
+    assert bad == ["FT004", "FT004"]
+    assert codes_for(findings, "ft004_good.py") == []
+
+
+def test_ft005_fixtures():
+    findings = analyze_fixtures(select=["FT005"])
+    assert len(codes_for(findings, "ft005_bad.py")) == 2
+    assert codes_for(findings, "ft005_good.py") == []
+
+
+def test_ft005_ops_match_accounting():
+    # the checker keeps its own copy (stdlib-only import graph); it must
+    # track the accounting module's op list exactly
+    from flashy_tpu.parallel.accounting import COLLECTIVE_OPS as REAL_OPS
+    assert tuple(COLLECTIVE_OPS) == tuple(REAL_OPS)
+
+
+def test_ft006_fixtures():
+    findings = analyze_fixtures(select=["FT006"])
+    assert len(codes_for(findings, "ft006_bad.py")) == 4
+    assert codes_for(findings, "ft006_good.py") == []
+
+
+# ----------------------------------------------------------------------
+# suppression + baseline
+# ----------------------------------------------------------------------
+def test_noqa_suppression_round_trip():
+    files = discover_files([FIXTURES / "suppressed.py"], FIXTURES)
+    active, suppressed = run_checks(files, analysis.ALL_CHECKERS)
+    # the only active finding is the line whose noqa names a WRONG code
+    assert [f.line for f in active] == [12]
+    assert active[0].code == "FT001"
+    assert len(suppressed) == 4
+    assert {f.code for f in suppressed} == {"FT001", "FT006"}
+
+
+def test_baseline_round_trip(tmp_path):
+    root = tmp_path / "proj"
+    shutil.copytree(FIXTURES, root)
+    files = discover_files([root], root)
+    findings, _ = run_checks(files, analysis.ALL_CHECKERS)
+    assert findings
+    by_rel = {f.rel: f for f in files}
+    baseline_path = tmp_path / "baseline.json"
+    save_baseline(baseline_path, findings, by_rel)
+
+    # same findings against the fresh baseline: nothing new
+    baseline = load_baseline(baseline_path)
+    assert new_findings(findings, by_rel, baseline) == []
+
+    # an EXTRA violation (even an identical line elsewhere) is new
+    extra = root / "fresh.py"
+    extra.write_text("def emit(tracer):\n"
+                     "    tracer.counter('BadTrack', n=1)\n")
+    files = discover_files([root], root)
+    findings, _ = run_checks(files, analysis.ALL_CHECKERS)
+    fresh = new_findings(findings, {f.rel: f for f in files}, baseline)
+    assert [f.path for f in fresh] == ["fresh.py"]
+    assert fresh[0].code == "FT006"
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    root = tmp_path / "proj"
+    root.mkdir()
+    target = root / "mod.py"
+    target.write_text("def emit(tracer):\n"
+                      "    tracer.counter('BadTrack', n=1)\n")
+    files = discover_files([root], root)
+    findings, _ = run_checks(files, analysis.ALL_CHECKERS)
+    baseline_path = tmp_path / "baseline.json"
+    save_baseline(baseline_path, findings, {f.rel: f for f in files})
+    # insert lines above: the finding moves but its fingerprint does not
+    target.write_text("import os\n\n\ndef emit(tracer):\n"
+                      "    tracer.counter('BadTrack', n=1)\n")
+    files = discover_files([root], root)
+    findings, _ = run_checks(files, analysis.ALL_CHECKERS)
+    assert findings and findings[0].line == 5
+    assert new_findings(findings, {f.rel: f for f in files},
+                        load_baseline(baseline_path)) == []
+
+
+# ----------------------------------------------------------------------
+# fault-site registry
+# ----------------------------------------------------------------------
+def test_registry_matches_sources():
+    # the committed generated module == what extraction produces today;
+    # FT003's staleness finding enforces the same equality, this test
+    # just fails with a clearer message
+    files = discover_files([REPO / "flashy_tpu"], REPO)
+    index = build_index(files)
+    assert index.framework_sites == set(registry.FAULT_SITES)
+    assert sorted(index.framework_prefixes) == sorted(
+        registry.FAULT_SITE_PREFIXES)
+
+
+def test_registry_generation_deterministic():
+    src1 = generate_registry_source({"b.site", "a.site"}, {"logger."})
+    src2 = generate_registry_source({"a.site", "b.site"}, {"logger."})
+    assert src1 == src2
+    assert src1.index("'a.site'") < src1.index("'b.site'")
+
+
+def test_registry_staleness_finding(tmp_path):
+    # a framework declaring a site the committed registry doesn't know
+    # must produce the FT003 staleness finding on the registry file
+    res = tmp_path / "flashy_tpu" / "resilience"
+    res.mkdir(parents=True)
+    (res / "chaos.py").write_text(
+        "def fault_point(site, **ctx):\n    pass\n\n\n"
+        "def tickle():\n    fault_point('brand.new_site')\n")
+    ana = tmp_path / "flashy_tpu" / "analysis"
+    ana.mkdir()
+    (ana / "registry.py").write_text("FAULT_SITES = frozenset()\n")
+    findings = analysis.analyze([tmp_path], tmp_path, select=["FT003"])
+    stale = [f for f in findings if "stale" in f.message]
+    assert len(stale) == 1
+    assert stale[0].path == "flashy_tpu/analysis/registry.py"
+    assert "brand.new_site" in stale[0].message
+    assert "--write-registry" in stale[0].hint
+
+
+def test_registry_judged_from_scanned_tree_not_installed(tmp_path):
+    # checkout B with its own consistent registry must be clean even
+    # though the INSTALLED registry knows none of its sites — and arm
+    # calls validate against B's registry, not the installed one
+    res = tmp_path / "flashy_tpu" / "resilience"
+    res.mkdir(parents=True)
+    (res / "chaos.py").write_text(
+        "def fault_point(site, **ctx):\n    pass\n\n\n"
+        "def tickle():\n    fault_point('other.checkout_site')\n")
+    ana = tmp_path / "flashy_tpu" / "analysis"
+    ana.mkdir()
+    (ana / "registry.py").write_text(
+        "FAULT_SITES = frozenset({'other.checkout_site'})\n"
+        "FAULT_SITE_PREFIXES = ()\n")
+    (tmp_path / "test_drill.py").write_text(
+        "def arm(inj):\n"
+        "    inj.fail_at('other.checkout_site', call=1)\n")
+    assert analysis.analyze([tmp_path], tmp_path, select=["FT003"]) == []
+
+
+def test_registry_lookup():
+    assert registry.is_registered_site("ckpt.write")
+    assert registry.is_registered_site("logger.wandb")   # prefix
+    assert not registry.is_registered_site("ckpt.wrtie")
+    assert registry.unknown_sites(["ckpt.write", "nope"]) == ["nope"]
+
+
+# ----------------------------------------------------------------------
+# CLI + the live-repo gate
+# ----------------------------------------------------------------------
+def test_cli_exit_codes(tmp_path, capsys):
+    root = tmp_path / "proj"
+    shutil.copytree(FIXTURES, root)
+    assert cli.main(["--root", str(root), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "FT001" in out and "new finding(s)" in out
+
+    baseline = tmp_path / "base.json"
+    assert cli.main(["--root", str(root), "--baseline", str(baseline),
+                     "--write-baseline"]) == 0
+    assert cli.main(["--root", str(root),
+                     "--baseline", str(baseline)]) == 0
+    assert json.loads(baseline.read_text())["entries"]
+
+    assert cli.main(["--root", str(root), "--select", "NOPE"]) == 2
+    assert cli.main([str(tmp_path / "missing.py")]) == 2
+    # an existing path OUTSIDE the scan root is a usage error, not a
+    # traceback
+    outside = tmp_path / "outside.py"
+    outside.write_text("x = 1\n")
+    assert cli.main(["--root", str(root), str(outside)]) == 2
+
+
+def test_cli_select(tmp_path, capsys):
+    root = tmp_path / "proj"
+    shutil.copytree(FIXTURES, root)
+    assert cli.main(["--root", str(root), "--no-baseline",
+                     "--select", "FT006"]) == 1
+    out = capsys.readouterr().out
+    assert "FT006" in out and "FT001" not in out
+
+
+def test_cli_list_checks(capsys):
+    assert cli.main(["--list-checks"]) == 0
+    out = capsys.readouterr().out
+    for code in ("FT001", "FT002", "FT003", "FT004", "FT005", "FT006"):
+        assert code in out
+
+
+def test_live_repo_clean_against_committed_baseline(capsys):
+    # THE acceptance gate: `python -m flashy_tpu.analysis` exits 0 on
+    # this repo with the committed baseline (which is empty — the PR-9
+    # sweep fixed every real violation instead of grandfathering it)
+    assert cli.main(["--root", str(REPO), "-q"]) == 0
+    assert load_baseline(REPO / analysis.baseline.DEFAULT_BASELINE_NAME) == {}
+
+
+# ----------------------------------------------------------------------
+# FaultInjector strict mode (runtime complement of FT003)
+# ----------------------------------------------------------------------
+def test_install_prebuilt_injector_honors_strict():
+    injector = chaos.FaultInjector()          # built lax...
+    assert chaos.install(injector, strict=True) is injector
+    assert injector.strict                    # ...but installed strict
+    injector.fail_at("ckpt.write", call=99)   # occurrence never reached
+    with pytest.raises(chaos.UnfiredFaultRules):
+        chaos.uninstall()
+
+
+def test_strict_uninstall_raises_on_unfired():
+    injector = chaos.install(strict=True)
+    injector.fail_at("ckpt.write", call=99)  # occurrence 99 never happens
+    chaos.fault_point("ckpt.write")
+    with pytest.raises(chaos.UnfiredFaultRules, match="ckpt.write"):
+        chaos.uninstall()
+    assert chaos.get_injector() is None      # uninstalled despite the raise
+
+
+def test_strict_uninstall_clean_when_all_fired():
+    injector = chaos.install(strict=True)
+    injector.fail_at("ckpt.write", call=1)
+    with pytest.raises(chaos.InjectedFault):
+        chaos.fault_point("ckpt.write")
+    chaos.uninstall()                        # no raise: the rule fired
+
+
+def test_nonstrict_uninstall_warns(caplog):
+    injector = chaos.install()
+    injector.preempt_at("drill.step", call=5)
+    with caplog.at_level(logging.WARNING, logger=chaos.logger.name):
+        chaos.uninstall()
+    assert any("never" in rec.message and "drill.step" in rec.getMessage()
+               for rec in caplog.records)
+
+
+def test_uninstall_verify_false_skips_check():
+    injector = chaos.install(strict=True)
+    injector.fail_at("ckpt.write", call=99)
+    chaos.uninstall(verify=False)            # error-path cleanup: silent
+
+
+def test_typo_site_caught_at_runtime_by_strict_mode():
+    # the runtime complement of the FT003 static check: a typo'd site
+    # sails through arming, fires nothing, and strict uninstall catches
+    # it even though the real site ticked right past it
+    injector = chaos.install(strict=True)
+    # deliberate typo — the whole point of this test:
+    injector.fail_at("ckpt.wrtie", call=1)  # flashy: noqa[FT003]
+    chaos.fault_point("ckpt.write")          # the REAL site fires freely
+    with pytest.raises(chaos.UnfiredFaultRules, match="wrtie"):
+        chaos.uninstall()
+
+
+def test_unfired_rules_reporting():
+    injector = chaos.FaultInjector()
+    # local sites ticked directly (no fault_point indirection):
+    injector.fail_at("a.site", call=1)  # flashy: noqa[FT003]
+    injector.act_at("b.site", call=3, action=lambda: None)  # flashy: noqa[FT003]
+    with pytest.raises(chaos.InjectedFault):
+        injector.tick("a.site")
+    assert len(injector.unfired_rules()) == 1
+    assert "b.site" in injector.unfired_rules()[0]
+    with pytest.raises(chaos.UnfiredFaultRules):
+        injector.verify_fired()

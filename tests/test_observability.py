@@ -43,7 +43,7 @@ def test_tracer_span_nesting_and_chrome_schema(tmp_path):
         time.sleep(0.01)
         with tracer.span("inner"):
             time.sleep(0.005)
-    tracer.instant("marker", note="hi")
+    tracer.instant("test/marker", note="hi")
     path = tracer.export_chrome_trace()
 
     payload = json.loads(path.read_text())
