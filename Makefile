@@ -87,6 +87,21 @@ zero-demo:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m flashy_tpu.parallel.zero --steps 3
 
+# Pipeline-schedule gate on 8 virtual CPU devices: GPipe vs 1F1B vs
+# interleaved-1F1B gradient steps on dense + MoE LMs over a pipe=4
+# mesh. Exit 1 unless 1F1B gradients match the GPipe oracle (MoE aux
+# included), the 1F1B activation stash stays flat when the microbatch
+# count doubles (while GPipe's residency grows), the interleaved
+# bubble is strictly below GPipe's at equal M, the pipeline/bubble
+# telemetry track was recorded, and zero post-warm-up recompiles were
+# reported. A couple of minutes; also run by the tests workflow.
+# (-W silences runpy's benign double-import warning: the package
+# __init__ must eagerly export the `pipeline` function, which puts the
+# submodule in sys.modules before runpy executes it.)
+pipeline-demo:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -W "ignore::RuntimeWarning:runpy" -m flashy_tpu.parallel.pipeline --steps 3
+
 # Streaming-datapipe drill on CPU: pack a synthetic jsonl+npy corpus
 # mixture into fixed [B, L] segment-masked batches, train a tiny LM,
 # kill it with a simulated SIGTERM mid-stream, resume from the
@@ -108,4 +123,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all analyze coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo zero-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all analyze coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo zero-demo pipeline-demo datapipe-demo docs native dist

@@ -84,7 +84,10 @@ DETAIL_PATH = os.path.join(_STATE_DIR, "BENCH_DETAIL.json")
 # Budget for the single stdout JSON line: the driver records only a
 # ~2,000-char tail of stdout, so the line must stay comfortably inside
 # it (r3's multi-KB line made BENCH_r03.json parse as null).
-MAX_LINE_CHARS = 1500
+# 1600 still clears the ~2,000-char driver tail (plus the ~100-char
+# metric prefix) with margin; raised from 1500 when the pipeline leg
+# became the 13th compact entry.
+MAX_LINE_CHARS = 1600
 
 # Peak bf16 matmul FLOP/s per chip, by device_kind substring (public
 # cloud.google.com/tpu/docs numbers).
@@ -895,6 +898,39 @@ def bench_decode(jax, on_tpu: bool):
     return result
 
 
+def _run_demo_subprocess(leg: str, module: str, args: tuple = (),
+                         timeout: float = 900):
+    """CPU-fallback protocol shared by the demo-backed legs (zero,
+    pipeline): run `python -m {module}` with 8 virtual CPU devices,
+    parse the last stdout line as the result JSON, surface a stderr
+    tail on parse failure, flag virtual devices and demo violations.
+    Returns the result dict (with `error` set when something failed).
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    cmd = [sys.executable, "-W", "ignore::RuntimeWarning:runpy",
+           "-m", module, *args]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"error": f"{leg} leg subprocess timed out"}
+    lines = (proc.stdout or "").strip().splitlines()
+    try:
+        result = json.loads(lines[-1])
+    except (IndexError, ValueError):
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return {"error": f"{leg} leg rc={proc.returncode}: "
+                         + " | ".join(tail)}
+    result["virtual_devices"] = True
+    if proc.returncode != 0:
+        result["error"] = f"{leg} demo reported a violation (see stderr)"
+    return result
+
+
 def bench_zero(jax, on_tpu: bool):
     """ZeRO-1 sharded weight update vs replicated vs FSDP on the LM:
     step time + per-chip optimizer-state HBM bytes per layout, plus the
@@ -910,28 +946,10 @@ def bench_zero(jax, on_tpu: bool):
         from flashy_tpu.parallel.zero import run_zero_bench
         result = run_zero_bench(steps=3)
     else:
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                            + " --xla_force_host_platform_device_count=8")
-        cmd = [sys.executable, "-m", "flashy_tpu.parallel.zero",
-               "--steps", "3"]
-        try:
-            proc = subprocess.run(
-                cmd, capture_output=True, text=True, timeout=900, env=env,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-        except subprocess.TimeoutExpired:
-            return {"error": "zero leg subprocess timed out"}
-        lines = (proc.stdout or "").strip().splitlines()
-        try:
-            result = json.loads(lines[-1])
-        except (IndexError, ValueError):
-            tail = (proc.stderr or "").strip().splitlines()[-3:]
-            return {"error": f"zero leg rc={proc.returncode}: "
-                             + " | ".join(tail)}
-        result["virtual_devices"] = True
-        if proc.returncode != 0:
-            result["error"] = "zero demo reported a violation (see stderr)"
+        result = _run_demo_subprocess(
+            "zero", "flashy_tpu.parallel.zero", ("--steps", "3"))
+        if "error" in result and "step_ms" not in result:
+            return result
     # compact-payload scalars (the nested dicts stay in BENCH_DETAIL)
     for mode in ("replicated", "zero1", "fsdp"):
         if mode in result.get("step_ms", {}):
@@ -942,6 +960,45 @@ def bench_zero(jax, on_tpu: bool):
     log(f"zero: opt bytes/chip zero1/replicated="
         f"{result.get('opt_bytes_ratio_zero1')} over "
         f"{result.get('n_devices')} devices; step_ms={result.get('step_ms')}; "
+        f"recompiles={result.get('recompiles')}")
+    return result
+
+
+def bench_pipeline(jax, on_tpu: bool):
+    """Pipeline schedules on the flagship LM over a 'pipe' mesh: GPipe
+    vs 1F1B vs interleaved-1F1B gradient steps — bubble_frac (counted
+    idle ticks), peak_stash_bytes (the O(S) 1F1B ring vs GPipe's O(M)
+    residency), step_ms, grad drift vs the GPipe oracle, and the
+    watchdog's post-warm-up recompile count (must be 0 — see
+    flashy_tpu/parallel/pipeline.py).
+
+    On the chip the measurement runs inline over the attached devices.
+    On CPU fallback it runs in a SUBPROCESS with 8 virtual devices (a
+    'pipe' axis over this host's single CPU device would be vacuous,
+    and the flag must be set before backend init — too late
+    in-process).
+    """
+    if on_tpu:
+        from flashy_tpu.parallel.pipeline import run_pipeline_bench
+        result = run_pipeline_bench(steps=3)
+    else:
+        result = _run_demo_subprocess(
+            "pipeline", "flashy_tpu.parallel.pipeline", ("--steps", "3"),
+            timeout=1200)
+        if "error" in result and "dense" not in result:
+            return result
+    # compact-payload scalars (the nested dicts stay in BENCH_DETAIL)
+    for name, stats in result.get("dense", {}).get("schedules", {}).items():
+        key = name.replace("-", "_")
+        for field in ("bubble_frac", "peak_stash_bytes", "step_ms",
+                      "grad_drift"):
+            if field in stats:
+                result[f"{field}_{key}"] = stats[field]
+    log(f"pipeline: bubble gpipe={result.get('bubble_frac_gpipe')} "
+        f"1f1b-int2={result.get('bubble_frac_1f1b_int2')}; stash bytes "
+        f"1f1b={result.get('stash_bytes_at_m')} (flat in M: "
+        f"{result.get('stash_flat_in_m')}) vs gpipe "
+        f"{result.get('gpipe_stash_bytes_at_m')}; "
         f"recompiles={result.get('recompiles')}")
     return result
 
@@ -1184,6 +1241,7 @@ _COMPACT_KEYS = {
     "attention": ("speedup", "flash_tuned_ms"),
     "zero": ("opt_bytes_ratio_zero1", "step_ms_zero1", "step_ms_replicated",
              "recompiles"),
+    "pipeline": ("bubble_frac_1f1b_int2", "stash_flat_in_m", "recompiles"),
     "ring": ("overhead_pct",),
     "datapipe": ("tokens_per_sec", "packing_efficiency"),
     "gan": ("steps_per_sec",),
@@ -1280,8 +1338,8 @@ def _persist_partial(extra: dict) -> None:
 _LEGS_FILTER = os.environ.get("FLASHY_TPU_BENCH_LEGS")
 LEG_ORDER = tuple(
     name for name in ("smoke", "mxu", "cifar", "lm", "attention", "zero",
-                      "ring", "gan", "decode", "datapipe", "host_sync",
-                      "all_reduce")
+                      "pipeline", "ring", "gan", "decode", "datapipe",
+                      "host_sync", "all_reduce")
     if _LEGS_FILTER is None or name in _LEGS_FILTER.split(","))
 
 
@@ -1337,6 +1395,7 @@ def child_main() -> None:
         "lm": lambda: bench_lm(jax, on_tpu, peak, measured_flops()),
         "attention": lambda: bench_flash_attention(jax, on_tpu),
         "zero": lambda: bench_zero(jax, on_tpu),
+        "pipeline": lambda: bench_pipeline(jax, on_tpu),
         "ring": lambda: bench_ring(jax, on_tpu),
         "decode": lambda: bench_decode(jax, on_tpu),
         "gan": lambda: bench_gan(jax, on_tpu),

@@ -126,6 +126,14 @@ class LMSolver(flashy_tpu.BaseSolver):
         aux_weight = cfg.model.get("moe_aux_weight", 0.01)
         pipe_stages = self.pipe_stages
         pipe_micro = cfg.get("pipeline_microbatches", None)
+        # Schedule selection: 'gpipe' (fill-drain, O(M) activations) or
+        # '1f1b' (PipeDream-flush, O(S) activation stash; interleave>1
+        # adds virtual stages that divide the bubble).
+        self.pipe_schedule = cfg.get("pipeline_schedule", "gpipe")
+        self.pipe_interleave = int(cfg.get("pipeline_interleave", 1))
+        if self.pipe_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"pipeline_schedule must be 'gpipe' or "
+                             f"'1f1b', got {self.pipe_schedule!r}")
         mesh = self.mesh
 
         if (cfg.get("loss", "dense") == "chunked"
@@ -135,11 +143,15 @@ class LMSolver(flashy_tpu.BaseSolver):
                 "parallelism (those paths need logits + aux losses); "
                 "use loss=dense.")
 
+        pipe_schedule, pipe_interleave = self.pipe_schedule, self.pipe_interleave
+
         def loss_fn(variables, tokens):
             if pipe_stages > 1:
                 from flashy_tpu.models import pipelined_apply
                 out = pipelined_apply(model, variables, tokens, mesh=mesh,
-                                      num_microbatches=pipe_micro)
+                                      num_microbatches=pipe_micro,
+                                      schedule=pipe_schedule,
+                                      interleave=pipe_interleave)
                 logits, aux = out if moe else (out, 0.0)
                 aux = aux_weight * aux if moe else 0.0
             elif moe:
@@ -162,8 +174,21 @@ class LMSolver(flashy_tpu.BaseSolver):
             return ce + aux
 
         from flashy_tpu.parallel import with_grad_accumulation
-        grad_fn = with_grad_accumulation(
-            jax.value_and_grad(loss_fn), cfg.get("accumulate", 1))
+        if pipe_stages > 1 and pipe_schedule == "1f1b":
+            # Train through the explicit 1F1B forward/backward program:
+            # same (loss, grads) signature, so grad accumulation (and
+            # zero_update, were it enabled) compose unchanged — the
+            # gradient leaves the pipeline once per step, after the
+            # last backward tick.
+            from flashy_tpu.models import pipelined_value_and_grad
+            base_grad_fn = pipelined_value_and_grad(
+                model, mesh=mesh, num_microbatches=pipe_micro,
+                interleave=pipe_interleave, schedule="1f1b",
+                aux_weight=aux_weight if moe else 0.0)
+        else:
+            base_grad_fn = jax.value_and_grad(loss_fn)
+        grad_fn = with_grad_accumulation(base_grad_fn,
+                                         cfg.get("accumulate", 1))
 
         ema_decay = self.ema_decay
 
@@ -186,7 +211,31 @@ class LMSolver(flashy_tpu.BaseSolver):
 
     def get_formatter(self, stage_name):
         return flashy_tpu.Formatter({"loss": ".4f", "ppl": ".1f",
-                                     "grad_norm": ".2f", "tokens_per_sec": ".0f"})
+                                     "grad_norm": ".2f", "tokens_per_sec": ".0f",
+                                     "bubble_frac": ".3f"})
+
+    def _pipeline_stats(self):
+        """Host-static schedule numbers for the active pipeline config:
+        bubble fraction, idle ticks and the exact stash-ring bytes (1F1B)
+        or the GPipe residency bound — the stage-metric /
+        `pipeline/bubble`-track payload. None when pipe=1."""
+        if self.pipe_stages <= 1:
+            return None
+        num_micro = self.cfg.get("pipeline_microbatches") or self.pipe_stages
+        accumulate = self.cfg.get("accumulate", 1)
+        mb = max(self.cfg.batch_size // accumulate // num_micro, 1)
+        mb_shape = (mb, self.cfg.seq_len, self.cfg.model.dim)
+        from flashy_tpu.parallel.schedules import (
+            gpipe_bubble_fraction, gpipe_stash_bytes, schedule_stats)
+        if self.pipe_schedule == "1f1b":
+            return schedule_stats(self.pipe_stages, num_micro,
+                                  self.pipe_interleave,
+                                  microbatch_shape=mb_shape)
+        return {"schedule": "gpipe",
+                "bubble_frac": round(gpipe_bubble_fraction(
+                    self.pipe_stages, num_micro), 6),
+                "peak_stash_bytes": gpipe_stash_bytes(
+                    self.pipe_stages, num_micro, mb_shape)}
 
     def batch_at(self, step: int, eval_set: bool = False) -> jax.Array:
         # Held-out data: the eval stream is an independently-seeded
@@ -205,16 +254,28 @@ class LMSolver(flashy_tpu.BaseSolver):
         metrics = {}
         begin = time.time()
         tokens_seen = 0
+        pipe_stats = self._pipeline_stats()
+        from flashy_tpu.observability import get_telemetry
+        telemetry = get_telemetry()
         for index in progress:
             global_step = (self.epoch - 1) * self.cfg.steps_per_epoch + index
             self.state, step_metrics = self._train_step(
                 self.state, self.batch_at(global_step))
             metrics = average(step_metrics)
             tokens_seen += self.cfg.batch_size * self.cfg.seq_len
+            if telemetry is not None and pipe_stats is not None:
+                # per-step sample of the schedule's idle-tick budget —
+                # the Perfetto `pipeline/bubble` counter track
+                telemetry.counter("pipeline/bubble", bubble_frac=float(
+                    pipe_stats["bubble_frac"]), idle_ticks_per_device=float(
+                        pipe_stats.get("idle_ticks_per_device", 0.0)))
             progress.update(**metrics)
         device_sync(self.state["params"])  # real completion: block_until_ready can misreport on proxy backends
         metrics["ppl"] = float(np.exp(min(metrics["loss"], 20.0)))
         metrics["tokens_per_sec"] = tokens_seen / (time.time() - begin)
+        if pipe_stats is not None:
+            metrics["bubble_frac"] = float(pipe_stats["bubble_frac"])
+            metrics["peak_stash_bytes"] = int(pipe_stats["peak_stash_bytes"])
         return metrics
 
     def valid(self):

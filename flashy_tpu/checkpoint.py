@@ -18,6 +18,7 @@ import pickle
 import typing as tp
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .resilience import chaos
@@ -476,6 +477,15 @@ def place_like(template: tp.Any, restored: tp.Any) -> tp.Any:
             and template.sharding is not None):
         if (hasattr(restored, "shape")
                 and tuple(restored.shape) == tuple(template.shape)):
+            if not getattr(template, "_committed", True):
+                # The live leaf is uncommitted (e.g. `jit(optax.init)`
+                # scalars like Adam's `count`, which land on the default
+                # device but FOLLOW the other arguments of the next
+                # jitted call). A device_put here would pin the restored
+                # value to one device and the next multi-device step
+                # would reject the mix ("incompatible devices") — keep
+                # it uncommitted, exactly like the value it replaces.
+                return jnp.asarray(restored)
             return jax.device_put(restored, template.sharding)
         return restored
     if isinstance(template, dict) and isinstance(restored, dict):

@@ -12,4 +12,5 @@ from .seq2seq import (Seq2SeqConfig, Seq2SeqTransformer, cached_translate,
 from .decoding import generate, init_cache, nucleus_filter
 from .quantize import (quantize_lm_params, dequantize_lm_params,
                        is_quantized)
-from .pipelined import pipelined_apply
+from .pipelined import (pipelined_apply, pipelined_value_and_grad,
+                        sequential_value_and_grad)

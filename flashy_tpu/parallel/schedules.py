@@ -1,0 +1,409 @@
+# Pipeline schedule generation. GPipe's fill-drain differentiates the
+# whole microbatch stream as one scan, so every microbatch's stashed
+# activations survive until the backward — peak residency O(M) in the
+# microbatch count, which caps exactly the knob (more microbatches) that
+# shrinks the (S-1)/(M+S-1) bubble. The schedules built here are the
+# PipeDream-flush family instead: 1F1B holds at most S microbatches in
+# flight per device (O(S) stash, flat in M) at the same bubble, and
+# interleaved virtual stages (v non-adjacent layer chunks per device)
+# divide the bubble by the interleave factor: (S-1)/(v*M + S-1).
+#
+# Everything here is HOST-side and static: a schedule is a set of numpy
+# per-(tick, device) tables that the jitted pipeline program consumes as
+# *data* (tick index is never a shape), plus exact bookkeeping — idle
+# ticks per device, stash-slot assignments from interval coloring — so
+# bubble_frac and peak_stash_bytes are provable properties of the
+# table, not hopes about the executable.
+"""1F1B / interleaved pipeline schedule tables (host-side, numpy-only)."""
+import dataclasses
+import functools
+import math
+import typing as tp
+
+import numpy as np
+
+# Work item kinds in the per-device timeline.
+FORWARD = "F"
+BACKWARD = "B"
+
+
+def bubble_fraction(num_stages: int, num_micro: int,
+                    interleave: int = 1) -> float:
+    """Ideal bubble fraction of the 1F1B family: (S-1)/(v*M + S-1).
+
+    With equal-cost forward/backward ticks each device idles 2(S-1)
+    chunk-ticks of a 2(v*M + S-1)-tick step; `interleave=1` reduces to
+    the GPipe fraction (1F1B trades memory, interleaving trades bubble).
+    The generated schedules achieve this exactly — tests compare it
+    against idle ticks counted from the tables.
+    """
+    return (num_stages - 1) / (interleave * num_micro + num_stages - 1)
+
+
+def gpipe_bubble_fraction(num_stages: int, num_micro: int) -> float:
+    """GPipe fill-drain bubble fraction (S-1)/(M+S-1) — the baseline."""
+    return (num_stages - 1) / (num_micro + num_stages - 1)
+
+
+def microbatch_bytes(microbatch_shape: tp.Sequence[int],
+                     dtype_size: int = 4) -> int:
+    """Bytes of one microbatch activation `[mb, ...]` at `dtype_size`."""
+    return int(math.prod(microbatch_shape)) * int(dtype_size)
+
+
+def gpipe_stash_bytes(num_stages: int, num_micro: int,
+                      microbatch_shape: tp.Sequence[int],
+                      dtype_size: int = 4) -> int:
+    """Lower bound on GPipe's live-activation residency per device.
+
+    Differentiating the fill-drain scan stashes at least the per-tick
+    carry (one microbatch activation) for every one of the M+S-1
+    forward ticks — the O(M) term the 1F1B stash ring removes. Real
+    residency is higher (per-layer residuals inside each stage); this
+    bound is what the demo compares against `PipelineSchedule`'s exact
+    allocation, so GPipe is flattered, not strawmanned.
+    """
+    return (num_micro + num_stages - 1) * microbatch_bytes(
+        microbatch_shape, dtype_size)
+
+
+def validate_pipeline_args(num_stages: int, num_micro: int, batch: int,
+                           interleave: int = 1,
+                           require_fill: bool = False) -> None:
+    """Validate the (S, M, B, v) combination with actionable messages.
+
+    `require_fill=True` adds the 1F1B constraints: M >= S (the steady
+    state needs a full fill of in-flight microbatches) and, for
+    interleave > 1, M divisible by S (chunk rotation walks microbatch
+    groups of size S).
+    """
+    if num_micro < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {num_micro}")
+    if interleave < 1:
+        raise ValueError(f"interleave must be >= 1, got {interleave}")
+    if batch % num_micro:
+        divisors = [m for m in range(1, batch + 1) if batch % m == 0]
+        raise ValueError(
+            f"batch {batch} is not divisible into {num_micro} microbatches; "
+            f"pick num_microbatches from the divisors of the batch "
+            f"(e.g. {divisors[-min(len(divisors), 6):]}) or pad the batch.")
+    if interleave > 1 and num_micro % num_stages:
+        # the chunk rotation walks microbatch groups of size S in BOTH
+        # modes (the forward order uses the same item formula)
+        raise ValueError(
+            f"interleaved 1F1B rotates virtual-stage chunks over "
+            f"microbatch groups of size S={num_stages}, so "
+            f"num_microbatches must be a multiple of S: got "
+            f"M={num_micro}. Use M in "
+            f"{[num_stages * k for k in range(1, 5)]}, or "
+            f"interleave=1.")
+    if require_fill and num_micro < num_stages:
+        raise ValueError(
+            f"1F1B needs num_microbatches >= num_stages (the steady "
+            f"state holds one in-flight microbatch per stage): got "
+            f"M={num_micro} < S={num_stages}. Raise num_microbatches "
+            f"to at least {num_stages}, or fall back to "
+            f"schedule='gpipe' for tiny batches.")
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSchedule:
+    """A fully-resolved pipeline schedule: per-(tick, device) tables.
+
+    All tables are int32 `[num_ticks, num_stages]` numpy arrays, meant
+    to be fed into the jitted pipeline program as inputs (values are
+    data; only `num_ticks` and the buffer depths shape the program).
+    Forward fields: `f_do` (1 when the device runs a forward this
+    tick), `f_chunk` (local virtual-stage index, 0..interleave-1),
+    `f_micro`, `f_slot` (activation-stash slot holding the input),
+    `f_from_x` (stage 0 of chunk 0: read the microbatched input
+    directly), `f_last` (global last chunk: the loss attaches here);
+    `rxf_do`/`rxf_slot` bank the activation arriving over `ppermute`
+    into the stash. Backward fields (`mode='train'` only) mirror them:
+    `b_do`, `b_chunk`, `b_micro`, `b_slot` (stashed input for the
+    recompute-VJP), `b_last`, `b_first`, `b_rx` (cotangent slot) and
+    `rxb_do`/`rxb_slot`.
+
+    `stash_depth`/`brx_depth` are exact interval-coloring results: the
+    smallest ring buffers that hold every live activation/cotangent.
+    For 1F1B at interleave=1 the stash depth is exactly S — the O(S)
+    memory claim, checked by tests rather than asserted in prose.
+    """
+    mode: str                    # 'train' | 'forward'
+    num_stages: int
+    num_micro: int
+    interleave: int
+    num_ticks: int
+    tables: tp.Mapping[str, np.ndarray]
+    stash_depth: int
+    brx_depth: int
+    idle_ticks: tp.Tuple[int, ...]   # per device, over the whole step
+
+    @property
+    def num_chunks(self) -> int:
+        return self.num_stages * self.interleave
+
+    @property
+    def bubble_frac(self) -> float:
+        """Idle fraction counted from the tables (not the formula)."""
+        return sum(self.idle_ticks) / (self.num_stages * self.num_ticks)
+
+    @property
+    def idle_ticks_per_device(self) -> float:
+        return sum(self.idle_ticks) / self.num_stages
+
+    def stash_bytes(self, microbatch_shape: tp.Sequence[int],
+                    dtype_size: int = 4) -> int:
+        """Exact schedule-buffer bytes per device: the activation stash
+        ring, the cotangent ring, their sentinel rows, and the two
+        in-flight `ppermute` messages. Flat in M at fixed (S, v)."""
+        per = microbatch_bytes(microbatch_shape, dtype_size)
+        rings = (self.stash_depth + 1) + (self.brx_depth + 1 if
+                                          self.mode == "train" else 0)
+        messages = 2 if self.mode == "train" else 1
+        return (rings + messages) * per
+
+    def stats(self, microbatch_shape: tp.Optional[tp.Sequence[int]] = None,
+              dtype_size: int = 4) -> tp.Dict[str, tp.Any]:
+        """One-stop summary for metrics/bench/demo reporting."""
+        out: tp.Dict[str, tp.Any] = {
+            "schedule": "1f1b" if self.interleave == 1 else
+                        f"1f1b-interleave{self.interleave}",
+            "num_stages": self.num_stages,
+            "num_micro": self.num_micro,
+            "interleave": self.interleave,
+            "num_ticks": self.num_ticks,
+            "bubble_frac": round(self.bubble_frac, 6),
+            "idle_ticks_per_device": self.idle_ticks_per_device,
+            "stash_depth": self.stash_depth,
+            "gpipe_bubble_frac": round(gpipe_bubble_fraction(
+                self.num_stages, self.num_micro), 6),
+        }
+        if microbatch_shape is not None:
+            out["peak_stash_bytes"] = self.stash_bytes(
+                microbatch_shape, dtype_size)
+            out["gpipe_stash_bytes"] = gpipe_stash_bytes(
+                self.num_stages, self.num_micro, microbatch_shape,
+                dtype_size)
+        return out
+
+
+def _device_orders(num_stages: int, num_micro: int, interleave: int,
+                   mode: str) -> tp.List[tp.List[tp.Tuple[str, int, int]]]:
+    """Megatron-ordered work lists per device: `(kind, chunk, micro)`
+    with `chunk` the LOCAL virtual-stage index.
+
+    Forwards walk microbatch groups of size S through the device's
+    chunks in rotation; backwards mirror it from the last chunk.
+    Warmup depth (S-d-1 plain, (S-d-1)*2 + (v-1)*S interleaved) is the
+    PipeDream-flush fill that bounds in-flight microbatches at O(S).
+    """
+    S, M, v = num_stages, num_micro, interleave
+    total = M * v
+
+    def fwd_item(i: int) -> tp.Tuple[str, int, int]:
+        if v == 1:
+            return (FORWARD, 0, i)
+        group = i // S
+        return (FORWARD, group % v, (group // v) * S + i % S)
+
+    def bwd_item(j: int) -> tp.Tuple[str, int, int]:
+        if v == 1:
+            return (BACKWARD, 0, j)
+        group = j // S
+        return (BACKWARD, v - 1 - (group % v), (group // v) * S + j % S)
+
+    orders = []
+    for d in range(S):
+        if mode == "forward":
+            orders.append([fwd_item(i) for i in range(total)])
+            continue
+        if v == 1:
+            warm = min(total, S - d - 1)
+        else:
+            warm = min(total, (S - d - 1) * 2 + (v - 1) * S)
+        items = [fwd_item(i) for i in range(warm)]
+        nf, nb = warm, 0
+        while nf < total or nb < total:
+            if nf < total:
+                items.append(fwd_item(nf))
+                nf += 1
+            if nb < total:
+                items.append(bwd_item(nb))
+                nb += 1
+        orders.append(items)
+    return orders
+
+
+def _simulate(num_stages: int, orders, num_chunks: int
+              ) -> tp.Tuple[tp.Dict[tp.Tuple[str, int, int], int], int]:
+    """Tick-accurate execution of the per-device work lists.
+
+    Each device runs its items strictly in order, one per tick, and
+    stalls when the item's producer has not completed by the *previous*
+    tick (`ppermute` delivers with one tick of latency). In-order
+    execution over a dependency DAG cannot deadlock; the budget check
+    turns a schedule-generator bug into a loud error instead of a spin.
+    """
+    S, C = num_stages, num_chunks
+    ptr = [0] * S
+    done: tp.Dict[tp.Tuple[str, int, int], int] = {}
+    budget = 8 * sum(len(o) for o in orders) + 64
+    t = 0
+    while any(ptr[d] < len(orders[d]) for d in range(S)):
+        if t > budget:
+            raise RuntimeError(
+                f"pipeline schedule simulation exceeded {budget} ticks — "
+                f"a generator bug produced an unsatisfiable order")
+        for d in range(S):
+            if ptr[d] >= len(orders[d]):
+                continue
+            kind, k, m = orders[d][ptr[d]]
+            c = k * S + d  # global chunk index
+            if kind == FORWARD:
+                ready = c == 0 or done.get((FORWARD, c - 1, m), t + 1) < t
+            elif c == C - 1:
+                ready = done.get((FORWARD, c, m), t + 1) < t
+            else:
+                ready = done.get((BACKWARD, c + 1, m), t + 1) < t
+            if ready:
+                done[(kind, c, m)] = t
+                ptr[d] += 1
+        t += 1
+    return done, t
+
+
+def _allocate_slots(intervals: tp.Sequence[tp.Tuple[tp.Any, int, int]]
+                    ) -> tp.Tuple[tp.Dict[tp.Any, int], int]:
+    """Greedy interval coloring: `(key, start, end)` inclusive ranges to
+    ring-buffer slots such that no two live ranges share a slot. Returns
+    `(key -> slot, depth)`. Inclusive non-overlap means a slot written
+    and a slot read at the same tick are never the same, so the jitted
+    tick body may bank arrivals and read stashes in any order."""
+    slots: tp.Dict[tp.Any, int] = {}
+    free_at: tp.List[int] = []  # per slot, last tick it is still live
+    for key, start, end in sorted(intervals, key=lambda it: (it[1], it[2])):
+        for idx, last in enumerate(free_at):
+            if last < start:
+                free_at[idx] = end
+                slots[key] = idx
+                break
+        else:
+            slots[key] = len(free_at)
+            free_at.append(end)
+    return slots, len(free_at)
+
+
+@functools.lru_cache(maxsize=32)
+def build_1f1b_schedule(num_stages: int, num_micro: int,
+                        interleave: int = 1,
+                        mode: str = "train") -> PipelineSchedule:
+    """Build (and cache) the full table set for a 1F1B schedule.
+
+    `mode='train'` is the one-forward-one-backward schedule;
+    `mode='forward'` is the forward half only (inference through the
+    same interleaved chunk placement). Deterministic in its arguments,
+    so the lru_cache can never serve a stale schedule.
+    """
+    if mode not in ("train", "forward"):
+        raise ValueError(f"mode must be 'train' or 'forward', got {mode!r}")
+    S, M, v = num_stages, num_micro, interleave
+    C = S * v
+    # forward-only orders are plain sequential fills — no steady-state
+    # 1F1B alternation, so M < S is legal there (small-batch inference)
+    validate_pipeline_args(S, M, batch=M, interleave=v,
+                           require_fill=(mode == "train"))
+    orders = _device_orders(S, M, v, mode)
+    done, T = _simulate(S, orders, C)
+
+    fields = ["f_do", "f_chunk", "f_micro", "f_slot", "f_from_x", "f_last",
+              "rxf_do", "rxf_slot"]
+    if mode == "train":
+        fields += ["b_do", "b_chunk", "b_micro", "b_slot", "b_last",
+                   "b_first", "b_rx", "rxb_do", "rxb_slot"]
+    tables = {name: np.zeros((T, S), np.int32) for name in fields}
+
+    stash_depth = 0
+    brx_depth = 0
+    for d in range(S):
+        act_intervals = []
+        brx_intervals = []
+        for k in range(v):
+            c = k * S + d
+            for m in range(M):
+                t_f = done[(FORWARD, c, m)]
+                start = t_f if c == 0 else done[(FORWARD, c - 1, m)] + 1
+                end = done[(BACKWARD, c, m)] if mode == "train" else t_f
+                act_intervals.append(((c, m), start, end))
+                if mode == "train" and c != C - 1:
+                    brx_intervals.append(
+                        ((c, m), done[(BACKWARD, c + 1, m)] + 1,
+                         done[(BACKWARD, c, m)]))
+        act_slots, depth = _allocate_slots(act_intervals)
+        stash_depth = max(stash_depth, depth)
+        brx_slots, depth = _allocate_slots(brx_intervals)
+        brx_depth = max(brx_depth, depth)
+
+        for k in range(v):
+            c = k * S + d
+            for m in range(M):
+                t_f = done[(FORWARD, c, m)]
+                slot = act_slots[(c, m)]
+                tables["f_do"][t_f, d] = 1
+                tables["f_chunk"][t_f, d] = k
+                tables["f_micro"][t_f, d] = m
+                tables["f_slot"][t_f, d] = slot
+                tables["f_last"][t_f, d] = int(c == C - 1)
+                if c == 0:
+                    tables["f_from_x"][t_f, d] = 1
+                else:
+                    arrive = done[(FORWARD, c - 1, m)] + 1
+                    tables["rxf_do"][arrive, d] = 1
+                    tables["rxf_slot"][arrive, d] = slot
+                if mode != "train":
+                    continue
+                t_b = done[(BACKWARD, c, m)]
+                tables["b_do"][t_b, d] = 1
+                tables["b_chunk"][t_b, d] = k
+                tables["b_micro"][t_b, d] = m
+                tables["b_slot"][t_b, d] = slot
+                tables["b_last"][t_b, d] = int(c == C - 1)
+                tables["b_first"][t_b, d] = int(c == 0)
+                if c != C - 1:
+                    tables["b_rx"][t_b, d] = brx_slots[(c, m)]
+                    arrive = done[(BACKWARD, c + 1, m)] + 1
+                    tables["rxb_do"][arrive, d] = 1
+                    tables["rxb_slot"][arrive, d] = brx_slots[(c, m)]
+
+    busy = tables["f_do"].sum(axis=0)
+    if mode == "train":
+        busy = busy + tables["b_do"].sum(axis=0)
+    idle = tuple(int(T - b) for b in busy)
+    for name, table in tables.items():
+        table.setflags(write=False)
+    return PipelineSchedule(
+        mode=mode, num_stages=S, num_micro=M, interleave=v, num_ticks=T,
+        tables=tables, stash_depth=int(stash_depth), brx_depth=int(brx_depth),
+        idle_ticks=idle)
+
+
+def schedule_stats(num_stages: int, num_micro: int, interleave: int = 1, *,
+                   mode: str = "train",
+                   microbatch_shape: tp.Optional[tp.Sequence[int]] = None,
+                   dtype_size: int = 4) -> tp.Dict[str, tp.Any]:
+    """Stats of the (cached) schedule — the host-side numbers the stage
+    metrics, the `pipeline/bubble` tracer track, the demo gates and the
+    bench leg all report. Degenerate single-stage pipelines have no
+    schedule (and no bubble)."""
+    if num_stages <= 1:
+        out: tp.Dict[str, tp.Any] = {
+            "schedule": "single-stage", "num_stages": 1,
+            "num_micro": num_micro, "interleave": 1, "num_ticks": num_micro,
+            "bubble_frac": 0.0, "idle_ticks_per_device": 0.0,
+            "stash_depth": 0, "gpipe_bubble_frac": 0.0}
+        if microbatch_shape is not None:
+            out["peak_stash_bytes"] = 0
+            out["gpipe_stash_bytes"] = 0
+        return out
+    schedule = build_1f1b_schedule(num_stages, num_micro, interleave, mode)
+    return schedule.stats(microbatch_shape, dtype_size)

@@ -180,6 +180,38 @@ def test_place_like_tolerates_mismatch():
     assert out["new"] == 5
 
 
+def test_place_like_keeps_uncommitted_leaves_uncommitted():
+    # Regression: `jit(optax.init)` scalars (Adam's `count`) come back
+    # UNCOMMITTED on the default device — they follow the other
+    # arguments of the next jitted call. place_like used to device_put
+    # them, committing the restored scalar to one device; the next
+    # multi-device train step then rejected the state ("Received
+    # incompatible devices": count on [0] vs params on the mesh) —
+    # resume was broken for every multi-device LM example run.
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from flashy_tpu.checkpoint import place_like
+    from flashy_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": -1})
+    params = {"w": jax.device_put(jnp.ones((8, 4)),
+                                  NamedSharding(mesh, P()))}
+    opt = optax.adam(1e-3)
+    live = jax.jit(opt.init)(params)
+    host = jax.tree_util.tree_map(np.asarray, live)
+    placed = place_like(live, host)
+
+    def committed(leaf):
+        return getattr(leaf, "_committed", None)
+
+    count_live, count_placed = live[0].count, placed[0].count
+    assert committed(count_placed) == committed(count_live)
+    # and the mixed state is accepted by a multi-device jitted step
+    out = jax.jit(lambda p, s: (p["w"].sum(), s[0].count + 1))(
+        params, placed)
+    assert int(out[1]) == 1
+
+
 def test_place_like_optax_namedtuple():
     import jax
     from flashy_tpu.checkpoint import place_like

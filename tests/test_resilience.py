@@ -645,3 +645,39 @@ def test_serve_pool_fault_queuefull_backpressure(injector):
     with pytest.raises(QueueFull):
         scheduler.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=2)
     assert scheduler.metrics.rejected == 1
+
+
+def test_pipeline_tick_fault_surfaces_cleanly():
+    """An injected fault at the `pipeline.tick` site must surface as a
+    clean typed failure from the schedule launch — before any device
+    collective runs, so it can never hang the pipe ring — and the
+    strict injector must agree the rule actually fired."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from flashy_tpu.parallel import make_mesh
+    from flashy_tpu.parallel.pipeline import pipeline_1f1b
+
+    mesh = make_mesh({"pipe": 2, "data": 4})
+    params = jax.device_put({"w": jnp.full((2, 4, 4), 0.1, jnp.float32)},
+                            NamedSharding(mesh, P("pipe")))
+    x = jnp.ones((4, 4), jnp.float32)
+
+    def step():
+        # driven eagerly: the host-side fault site ticks once per call
+        return pipeline_1f1b(
+            lambda p, h: jnp.tanh(h @ p["w"]), params, x,
+            loss_fn=lambda lp, h: (h ** 2).mean(), mesh=mesh,
+            num_microbatches=2)
+
+    injector = chaos.install(strict=True)
+    try:
+        injector.fail_at("pipeline.tick", call=2)
+        loss, grads = step()  # call 1: schedule runs normally
+        assert np.isfinite(float(loss))
+        with pytest.raises(chaos.InjectedFault):
+            step()
+        assert injector.hits("pipeline.tick") == 1
+    finally:
+        chaos.uninstall()  # strict: raises if the armed rule never fired
