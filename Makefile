@@ -88,13 +88,15 @@ zero-demo:
 		python -m flashy_tpu.parallel.zero --steps 3
 
 # Pipeline-schedule gate on 8 virtual CPU devices: GPipe vs 1F1B vs
-# interleaved-1F1B gradient steps on dense + MoE LMs over a pipe=4
-# mesh. Exit 1 unless 1F1B gradients match the GPipe oracle (MoE aux
-# included), the 1F1B activation stash stays flat when the microbatch
-# count doubles (while GPipe's residency grows), the interleaved
-# bubble is strictly below GPipe's at equal M, the pipeline/bubble
-# telemetry track was recorded, and zero post-warm-up recompiles were
-# reported. A couple of minutes; also run by the tests workflow.
+# interleaved vs packed-1F1B gradient steps on dense + MoE LMs over a
+# pipe=4 mesh. Exit 1 unless 1F1B gradients match the GPipe oracle
+# (MoE aux included), packed gradients are BIT-identical to unpacked
+# 1F1B with realized step_ms strictly below it at equal (S, M, v),
+# the 1F1B activation stash stays flat when the microbatch count
+# doubles (while GPipe's residency grows), the interleaved bubble is
+# strictly below GPipe's at equal M, the pipeline/bubble telemetry
+# track was recorded, and zero post-warm-up recompiles were reported.
+# A couple of minutes; also run by the tests workflow.
 # (-W silences runpy's benign double-import warning: the package
 # __init__ must eagerly export the `pipeline` function, which puts the
 # submodule in sys.modules before runpy executes it.)

@@ -84,10 +84,12 @@ DETAIL_PATH = os.path.join(_STATE_DIR, "BENCH_DETAIL.json")
 # Budget for the single stdout JSON line: the driver records only a
 # ~2,000-char tail of stdout, so the line must stay comfortably inside
 # it (r3's multi-KB line made BENCH_r03.json parse as null).
-# 1600 still clears the ~2,000-char driver tail (plus the ~100-char
-# metric prefix) with margin; raised from 1500 when the pipeline leg
-# became the 13th compact entry.
-MAX_LINE_CHARS = 1600
+# 1700 still clears the ~2,000-char driver tail (plus the ~100-char
+# metric prefix) with ~200 chars of margin; raised from 1500 when the
+# pipeline leg became the 13th compact entry, and from 1600 when it
+# grew the three packed-schedule aliases (worst case measured 1665 by
+# test_compact_line_fits_driver_tail_worst_case).
+MAX_LINE_CHARS = 1700
 
 # Peak bf16 matmul FLOP/s per chip, by device_kind substring (public
 # cloud.google.com/tpu/docs numbers).
@@ -966,10 +968,14 @@ def bench_zero(jax, on_tpu: bool):
 
 def bench_pipeline(jax, on_tpu: bool):
     """Pipeline schedules on the flagship LM over a 'pipe' mesh: GPipe
-    vs 1F1B vs interleaved-1F1B gradient steps — bubble_frac (counted
-    idle ticks), peak_stash_bytes (the O(S) 1F1B ring vs GPipe's O(M)
-    residency), step_ms, grad drift vs the GPipe oracle, and the
-    watchdog's post-warm-up recompile count (must be 0 — see
+    vs 1F1B vs interleaved vs packed-1F1B gradient steps — bubble_frac
+    (counted idle ticks; idle lanes for packed), peak_stash_bytes (the
+    O(S) 1F1B ring vs GPipe's O(M) residency), step_ms, grad drift vs
+    the GPipe oracle, tick_efficiency (realized step_ms over the
+    schedule-theoretic tick bound, per-tick cost calibrated on the
+    unpacked 1f1b leg — the counted-vs-realized gap tracker), packed
+    bitwise-parity + step ratio vs unpacked, and the watchdog's
+    post-warm-up recompile count (must be 0 — see
     flashy_tpu/parallel/pipeline.py).
 
     On the chip the measurement runs inline over the attached devices.
@@ -991,12 +997,28 @@ def bench_pipeline(jax, on_tpu: bool):
     for name, stats in result.get("dense", {}).get("schedules", {}).items():
         key = name.replace("-", "_")
         for field in ("bubble_frac", "peak_stash_bytes", "step_ms",
-                      "grad_drift"):
+                      "grad_drift", "num_ticks", "tick_efficiency",
+                      "step_ms_vs_unpacked", "grads_bitwise_vs_unpacked"):
             if field in stats:
                 result[f"{field}_{key}"] = stats[field]
+    # short aliases for the stdout line's whitelist — the driver-tail
+    # budget cannot afford the flattened long names
+    packed = result.get("dense", {}).get("schedules", {}).get(
+        "packed_1f1b", {})
+    if "step_ms_vs_unpacked" in packed:
+        result["packed_step_ratio"] = packed["step_ms_vs_unpacked"]
+    if "tick_efficiency" in packed:
+        result["packed_tick_eff"] = packed["tick_efficiency"]
+    if "grads_bitwise_vs_unpacked" in packed:
+        result["packed_bitwise"] = packed["grads_bitwise_vs_unpacked"]
     log(f"pipeline: bubble gpipe={result.get('bubble_frac_gpipe')} "
-        f"1f1b-int2={result.get('bubble_frac_1f1b_int2')}; stash bytes "
-        f"1f1b={result.get('stash_bytes_at_m')} (flat in M: "
+        f"1f1b-int2={result.get('bubble_frac_1f1b_int2')}; packed step "
+        f"{result.get('step_ms_packed_1f1b')}ms vs 1f1b "
+        f"{result.get('step_ms_1f1b')}ms (ratio "
+        f"{result.get('step_ms_vs_unpacked_packed_1f1b')}, bitwise "
+        f"{result.get('grads_bitwise_vs_unpacked_packed_1f1b')}); "
+        f"tick_eff packed={result.get('tick_efficiency_packed_1f1b')}; "
+        f"stash bytes 1f1b={result.get('stash_bytes_at_m')} (flat in M: "
         f"{result.get('stash_flat_in_m')}) vs gpipe "
         f"{result.get('gpipe_stash_bytes_at_m')}; "
         f"recompiles={result.get('recompiles')}")
@@ -1241,7 +1263,8 @@ _COMPACT_KEYS = {
     "attention": ("speedup", "flash_tuned_ms"),
     "zero": ("opt_bytes_ratio_zero1", "step_ms_zero1", "step_ms_replicated",
              "recompiles"),
-    "pipeline": ("bubble_frac_1f1b_int2", "stash_flat_in_m", "recompiles"),
+    "pipeline": ("bubble_frac_1f1b_int2", "stash_flat_in_m", "recompiles",
+                 "packed_step_ratio", "packed_tick_eff", "packed_bitwise"),
     "ring": ("overhead_pct",),
     "datapipe": ("tokens_per_sec", "packing_efficiency"),
     "gan": ("steps_per_sec",),

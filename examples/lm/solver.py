@@ -126,14 +126,18 @@ class LMSolver(flashy_tpu.BaseSolver):
         aux_weight = cfg.model.get("moe_aux_weight", 0.01)
         pipe_stages = self.pipe_stages
         pipe_micro = cfg.get("pipeline_microbatches", None)
-        # Schedule selection: 'gpipe' (fill-drain, O(M) activations) or
+        # Schedule selection: 'gpipe' (fill-drain, O(M) activations),
         # '1f1b' (PipeDream-flush, O(S) activation stash; interleave>1
-        # adds virtual stages that divide the bubble).
+        # adds virtual stages that divide the bubble), or 'packed_1f1b'
+        # (training ticks ~halved: steady-state F and B co-scheduled
+        # into one tick, gradients bit-identical to '1f1b').
         self.pipe_schedule = cfg.get("pipeline_schedule", "gpipe")
         self.pipe_interleave = int(cfg.get("pipeline_interleave", 1))
-        if self.pipe_schedule not in ("gpipe", "1f1b"):
-            raise ValueError(f"pipeline_schedule must be 'gpipe' or "
-                             f"'1f1b', got {self.pipe_schedule!r}")
+        from flashy_tpu.parallel.schedules import KNOWN_SCHEDULES
+        if self.pipe_schedule not in KNOWN_SCHEDULES:
+            raise ValueError(f"pipeline_schedule must be one of "
+                             f"{KNOWN_SCHEDULES}, got "
+                             f"{self.pipe_schedule!r}")
         mesh = self.mesh
 
         if (cfg.get("loss", "dense") == "chunked"
@@ -148,9 +152,15 @@ class LMSolver(flashy_tpu.BaseSolver):
         def loss_fn(variables, tokens):
             if pipe_stages > 1:
                 from flashy_tpu.models import pipelined_apply
+                # packed has no forward-only schedule (nothing to pack
+                # without a backward lane): eval forwards route through
+                # the plain 1f1b placement, which is numerically the
+                # same forward.
+                eval_schedule = ("1f1b" if pipe_schedule == "packed_1f1b"
+                                 else pipe_schedule)
                 out = pipelined_apply(model, variables, tokens, mesh=mesh,
                                       num_microbatches=pipe_micro,
-                                      schedule=pipe_schedule,
+                                      schedule=eval_schedule,
                                       interleave=pipe_interleave)
                 logits, aux = out if moe else (out, 0.0)
                 aux = aux_weight * aux if moe else 0.0
@@ -174,8 +184,9 @@ class LMSolver(flashy_tpu.BaseSolver):
             return ce + aux
 
         from flashy_tpu.parallel import with_grad_accumulation
-        if pipe_stages > 1 and pipe_schedule == "1f1b":
-            # Train through the explicit 1F1B forward/backward program:
+        if pipe_stages > 1 and pipe_schedule in ("1f1b", "packed_1f1b"):
+            # Train through the explicit 1F1B forward/backward program
+            # (packed: steady-state F and B co-scheduled into one tick):
             # same (loss, grads) signature, so grad accumulation (and
             # zero_update, were it enabled) compose unchanged — the
             # gradient leaves the pipeline once per step, after the
@@ -183,7 +194,7 @@ class LMSolver(flashy_tpu.BaseSolver):
             from flashy_tpu.models import pipelined_value_and_grad
             base_grad_fn = pipelined_value_and_grad(
                 model, mesh=mesh, num_microbatches=pipe_micro,
-                interleave=pipe_interleave, schedule="1f1b",
+                interleave=pipe_interleave, schedule=pipe_schedule,
                 aux_weight=aux_weight if moe else 0.0)
         else:
             base_grad_fn = jax.value_and_grad(loss_fn)
@@ -227,9 +238,14 @@ class LMSolver(flashy_tpu.BaseSolver):
         mb_shape = (mb, self.cfg.seq_len, self.cfg.model.dim)
         from flashy_tpu.parallel.schedules import (
             gpipe_bubble_fraction, gpipe_stash_bytes, schedule_stats)
-        if self.pipe_schedule == "1f1b":
+        if self.pipe_schedule in ("1f1b", "packed_1f1b"):
+            from flashy_tpu.parallel.pipeline import default_overlap
+            packed = self.pipe_schedule == "packed_1f1b"
             return schedule_stats(self.pipe_stages, num_micro,
-                                  self.pipe_interleave,
+                                  self.pipe_interleave, packed=packed,
+                                  overlap=default_overlap(
+                                      packed, self.pipe_interleave,
+                                      self.mesh),
                                   microbatch_shape=mb_shape)
         return {"schedule": "gpipe",
                 "bubble_frac": round(gpipe_bubble_fraction(

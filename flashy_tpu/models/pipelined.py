@@ -3,9 +3,11 @@
 # TransformerConfig.scan_layers) is split into `pipe` stages; embedding
 # and head replicate while activations stream through the stages under
 # a selectable schedule — GPipe fill-drain (the differentiable
-# reference) or 1F1B/interleaved (flashy_tpu.parallel.pipeline's
+# reference), 1F1B/interleaved (flashy_tpu.parallel.pipeline's
 # explicit forward/backward program: O(stages) activation memory and a
-# bubble divided by the interleave factor).
+# bubble divided by the interleave factor), or packed 1F1B (training
+# only: F and B co-scheduled into one tick, ~halving the step's ticks
+# with bit-identical gradients).
 """pipelined_apply / pipelined_value_and_grad: scan-stacked TransformerLM
 over the 'pipe' axis under GPipe or 1F1B schedules."""
 import typing as tp
@@ -13,9 +15,8 @@ import typing as tp
 import jax
 import jax.numpy as jnp
 
+from ..parallel.schedules import KNOWN_SCHEDULES as SCHEDULES
 from .transformer import Block, TransformerLM, rmsnorm as _rmsnorm
-
-SCHEDULES = ("gpipe", "1f1b")
 
 
 def _chunked_stage(model: TransformerLM, variables: tp.Mapping,
@@ -124,6 +125,13 @@ def pipelined_apply(model: TransformerLM, variables: tp.Mapping,
     if schedule not in SCHEDULES:
         raise ValueError(f"schedule must be one of {SCHEDULES}, "
                          f"got {schedule!r}")
+    if schedule == "packed_1f1b":
+        raise ValueError(
+            "schedule='packed_1f1b' has no forward-only spelling: packing "
+            "pairs each forward tick with a backward, which is meaningless "
+            "without a backward lane. Use schedule='1f1b' for pipelined "
+            "forwards, or pipelined_value_and_grad(schedule='packed_1f1b') "
+            "for training.")
     if schedule == "gpipe" and interleave != 1:
         raise ValueError(
             "interleave>1 (virtual stages) is a 1F1B-family feature; "
@@ -208,7 +216,9 @@ def sequential_value_and_grad(model: TransformerLM, *,
 def pipelined_value_and_grad(model: TransformerLM, *, mesh=None,
                              num_microbatches: tp.Optional[int] = None,
                              interleave: int = 1, schedule: str = "1f1b",
-                             aux_weight: float = 0.0) -> tp.Callable:
+                             aux_weight: float = 0.0,
+                             overlap: tp.Optional[bool] = None
+                             ) -> tp.Callable:
     """Build a pipelined LM training grad-fn in the
     `jax.value_and_grad` convention: `fn(variables, tokens) -> (loss,
     grads)` with `loss = ce + aux_weight * moe_aux` and `grads`
@@ -219,7 +229,12 @@ def pipelined_value_and_grad(model: TransformerLM, *, mesh=None,
     stashed in a fixed O(stages) ring (recompute-VJP backward), the
     embedding gradient assembled from both its uses (the input lookup
     via the returned d/dx, the tied head via the loss-parameter
-    gradient). `schedule='gpipe'` is `jax.value_and_grad` over
+    gradient). `schedule='packed_1f1b'` co-schedules the steady
+    state's F and B into one tick — `schedules.packed_ticks(S, M, v)`
+    total instead of `2(vM+S-1)`, gradients bit-identical to '1f1b' —
+    and `overlap` (default: auto, on for tpu/gpu at interleave=1)
+    double-buffers the ring so the `ppermute` hops hide under stage
+    compute. `schedule='gpipe'` is `jax.value_and_grad` over
     :func:`pipelined_apply` — the differentiation-of-the-scan oracle
     the 1F1B gradients are gated against.
 
@@ -267,7 +282,8 @@ def pipelined_value_and_grad(model: TransformerLM, *, mesh=None,
             stage_fn, stage_params, x, loss_fn=micro_loss,
             loss_params=loss_params, targets=tokens, mesh=pipe_mesh,
             num_microbatches=num_microbatches, interleave=interleave,
-            has_aux=moe, aux_weight=aux_weight if moe else 0.0)
+            has_aux=moe, aux_weight=aux_weight if moe else 0.0,
+            packed=(schedule == "packed_1f1b"), overlap=overlap)
         if moe:
             (ce, aux), grads = result
             loss = ce + aux_weight * aux

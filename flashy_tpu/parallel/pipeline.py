@@ -31,8 +31,8 @@ from .. import _compat
 from ..resilience import chaos
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .schedules import (PipelineSchedule, build_1f1b_schedule,
-                        validate_pipeline_args)
+from .schedules import (PACKED_FORWARD_ERROR, PipelineSchedule,
+                        build_1f1b_schedule, validate_pipeline_args)
 
 
 def _stage_body(stage_fn, params, x_micro, axis, num_stages, num_micro,
@@ -205,7 +205,8 @@ def pipeline_1f1b(stage_fn: tp.Callable, stage_params: tp.Any, x: jax.Array,
                   mesh: tp.Optional[Mesh] = None, axis: str = "pipe",
                   num_microbatches: tp.Optional[int] = None,
                   interleave: int = 1, has_aux: bool = False,
-                  aux_weight: float = 0.0):
+                  aux_weight: float = 0.0, packed: bool = False,
+                  overlap: tp.Optional[bool] = None):
     """Run a stage function under the 1F1B (PipeDream-flush) schedule.
 
     The schedule is an explicit per-tick program (one `lax.scan` over
@@ -244,6 +245,26 @@ def pipeline_1f1b(stage_fn: tp.Callable, stage_params: tp.Any, x: jax.Array,
         aux_weight: weight of the summed per-(chunk, microbatch) aux
             scalars in the differentiated objective
             `mean_m loss + aux_weight * mean_m (sum_c aux)`.
+        packed: co-schedule the steady state's forward and backward
+            into one tick (train only): the schedule tables set `f_do`
+            and `b_do` together, so the always-both-lanes SPMD body
+            does useful work in both lanes and the step shrinks from
+            `2(vM+S-1)` to `schedules.packed_ticks(S, M, v)` ticks.
+            Gradients are BIT-IDENTICAL to the unpacked schedule (same
+            per-microbatch compute, same f32 accumulation order per
+            chunk); the in-flight bound grows to ~2S (still O(S), flat
+            in M). Requires `loss_fn` — packing is meaningless without
+            a backward lane.
+        overlap: double-buffer the ring (packed, interleave=1 only):
+            each tick's `ppermute` hops are issued from the PREVIOUS
+            tick's banked outputs and their results banked after this
+            tick's stage compute, so on backends with async collectives
+            the hop latency hides under the stage matmuls. Costs one
+            extra latency tick per hop in the schedule
+            (`M + 4(S-1)` total). Default `None` resolves to True on
+            tpu/gpu backends (whose async start/done collective pairs
+            can run under compute) and False on cpu (hops serialize
+            regardless, so the extra fill ticks would be a pure loss).
 
     Returns:
         Forward mode (`loss_fn=None`): the final activations `[B, ...]`
@@ -261,6 +282,17 @@ def pipeline_1f1b(stage_fn: tp.Callable, stage_params: tp.Any, x: jax.Array,
     num_stages = mesh.shape[axis]
     num_chunks = num_stages * interleave
     mode = "forward" if loss_fn is None else "train"
+    if packed and mode == "forward":
+        # checked up front (not via validate_pipeline_args, whose other
+        # checks need real shapes) so the rejection stays uniform even
+        # on the degenerate single-stage path below
+        raise ValueError(PACKED_FORWARD_ERROR)
+    if overlap is None:
+        overlap = default_overlap(packed, interleave, mesh)
+    if overlap and not packed:
+        raise ValueError("overlap=True double-buffers the PACKED ring; "
+                         "pass packed=True as well (the unpacked 1F1B "
+                         "tables stay at hop latency 1)")
     _check_chunk_params(stage_params, num_chunks, interleave, num_stages)
     if num_stages == 1:
         return _single_stage_1f1b(stage_fn, stage_params, x, loss_fn,
@@ -270,14 +302,23 @@ def pipeline_1f1b(stage_fn: tp.Callable, stage_params: tp.Any, x: jax.Array,
     batch = x.shape[0]
     validate_pipeline_args(num_stages, num_micro, batch,
                            interleave=interleave,
-                           require_fill=(mode == "train"))
-    schedule = build_1f1b_schedule(num_stages, num_micro, interleave, mode)
+                           require_fill=(mode == "train"),
+                           schedule="packed_1f1b" if packed else "1f1b",
+                           mode=mode)
+    schedule = build_1f1b_schedule(num_stages, num_micro, interleave, mode,
+                                   packed=packed, overlap=overlap)
     # Deterministic host-side fault site: one tick per schedule launch
     # (trace time under jit; every call when driven eagerly). A fault
     # here surfaces as a clean typed failure before any device program
     # runs — never a hang inside the collective schedule.
     chaos.fault_point("pipeline.tick", mode=mode,
                       ticks=schedule.num_ticks)
+    if packed:
+        # same contract as pipeline.tick, distinct site: chaos drills
+        # can target the packed timeline without touching 1f1b runs
+        chaos.fault_point("pipeline.packed_tick", mode=mode,
+                          ticks=schedule.num_ticks,
+                          overlap=bool(overlap))
     x_micro = x.reshape(num_micro, batch // num_micro, *x.shape[1:])
     targets_micro = jax.tree_util.tree_map(
         lambda t: t.reshape(num_micro, t.shape[0] // num_micro,
@@ -379,19 +420,31 @@ def _1f1b_device_body(local_params, x_micro, loss_params, targets_micro,
                       schedule: PipelineSchedule, has_aux, aux_weight):
     """One device's 1F1B program: a fixed-shape scan over schedule ticks.
 
-    Every tick banks the two arriving `ppermute` messages into their
-    ring-buffer slots (sentinel row when idle), runs one (possibly
-    masked) forward from the stash, and — in training mode — one
-    recompute-VJP backward seeded either from the arrived cotangent or,
-    on the last chunk, from the loss. All indices come from the
-    schedule tables as DATA; garbage lanes are routed to sentinel rows
-    and zero-masked, never shape-special-cased, so the executable is
-    identical for every (tick, device).
+    Every tick issues the ring hops FIRST — `ppermute` of the previous
+    tick's banked outputs, carried pre-hop so the collective and the
+    stage compute share no data edge until the bank point — then banks
+    the arrivals into their ring-buffer slots (sentinel row when idle),
+    runs one (possibly masked) forward from the stash, and — in
+    training mode — one recompute-VJP backward seeded either from the
+    arrived cotangent or, on the last chunk, from the loss. At hop
+    latency 1 the arrivals bank BEFORE the compute (the steady state
+    consumes same-tick arrivals); at hop latency 2 (packed overlap)
+    they bank AFTER it, so the hop's result is not needed until the
+    tick's very end and the collective can run under the stage matmuls
+    on backends with async collective-permute. All indices come from
+    the schedule tables as DATA; garbage lanes are routed to sentinel
+    rows and zero-masked, never shape-special-cased, so the executable
+    is identical for every (tick, device).
     """
     S = schedule.num_stages
     M = schedule.num_micro
     Ds, Db = schedule.stash_depth, schedule.brx_depth
     train = schedule.mode == "train"
+    bank_late = schedule.hop_latency > 1
+    # latency-2 schedules are packed, and packed is train-only — the
+    # forward-mode path below may therefore assume early banking
+    assert not (bank_late and not train), \
+        "overlap (hop latency 2) schedules are train-only"
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
     perm_bwd = [(i, (i - 1) % S) for i in range(S)]
     f32 = jnp.float32
@@ -408,16 +461,21 @@ def _1f1b_device_body(local_params, x_micro, loss_params, targets_micro,
 
     mb_zero = jnp.zeros_like(x_micro[0])
     act0 = jnp.zeros((Ds + 1,) + mb_zero.shape, mb_zero.dtype) + mb_zero
+    # The carry holds the PRE-hop outputs ("y", and "dxm" in train):
+    # tick t permutes tick t-1's output itself, so the hop is issued at
+    # the top of the body and its result is consumed only at the bank
+    # point — before the compute at hop latency 1 (the same dataflow as
+    # permuting at the previous tick's end), after it at latency 2.
     carry = {
         "act": act0,
-        "fmsg": mb_zero,
+        "y": mb_zero,
         "aux": _compat.pcast_varying(jnp.zeros((), f32), (axis,)),
     }
     if train:
         carry.update({
             "brx": jnp.zeros((Db + 1,) + mb_zero.shape, mb_zero.dtype)
                    + mb_zero,
-            "bmsg": mb_zero,
+            "dxm": mb_zero,
             "gs": jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, f32) + p * 0, local_params),
             "glp": jax.tree_util.tree_map(
@@ -430,12 +488,31 @@ def _1f1b_device_body(local_params, x_micro, loss_params, targets_micro,
         carry["out"] = jnp.zeros((M + 1,) + mb_zero.shape,
                                  mb_zero.dtype) + mb_zero
 
-    def tick(carry, col):
-        act = carry["act"]
-        # 1. bank the arrived activation (sentinel row Ds when idle)
-        act = jax.lax.dynamic_update_index_in_dim(
-            act, carry["fmsg"],
+    def bank_f(act, fmsg, col):
+        # bank the arrived activation (sentinel row Ds when idle)
+        return jax.lax.dynamic_update_index_in_dim(
+            act, fmsg,
             jnp.where(col["rxf_do"] == 1, col["rxf_slot"], Ds), 0)
+
+    def bank_b(brx, bmsg, col):
+        # bank the arrived cotangent (sentinel row Db when idle)
+        return jax.lax.dynamic_update_index_in_dim(
+            brx, bmsg,
+            jnp.where(col["rxb_do"] == 1, col["rxb_slot"], Db), 0)
+
+    def tick(carry, col):
+        # 1. issue this tick's ring hops from the previous tick's
+        #    outputs. At hop latency 2 nothing below reads fmsg/bmsg
+        #    until the very end of the body, so an async
+        #    collective-permute runs under the whole tick's compute.
+        fmsg = jax.lax.ppermute(carry["y"], axis, perm_fwd)
+        bmsg = jax.lax.ppermute(carry["dxm"], axis, perm_bwd) if train \
+            else None
+        act = carry["act"]
+        if not bank_late:
+            # hop latency 1: the steady state consumes same-tick
+            # arrivals, so bank before the compute reads the ring
+            act = bank_f(act, fmsg, col)
         # 2. forward: input from the stash ring or the microbatched x
         f_on = col["f_do"] == 1
         x_f = jnp.where(
@@ -459,7 +536,7 @@ def _1f1b_device_body(local_params, x_micro, loss_params, targets_micro,
             aux_f = jnp.zeros((), f32)
         out = {"act": act,
                "aux": carry["aux"] + jnp.where(f_on, aux_f.astype(f32), 0.0),
-               "fmsg": jax.lax.ppermute(y, axis, perm_fwd)}
+               "y": y}
         if not train:
             out["out"] = jax.lax.dynamic_update_index_in_dim(
                 carry["out"], y,
@@ -467,10 +544,11 @@ def _1f1b_device_body(local_params, x_micro, loss_params, targets_micro,
                           col["f_micro"], M), 0)
             return out, None
 
-        # 3. bank the arrived cotangent
-        brx = jax.lax.dynamic_update_index_in_dim(
-            carry["brx"], carry["bmsg"],
-            jnp.where(col["rxb_do"] == 1, col["rxb_slot"], Db), 0)
+        # 3. the arrived cotangent (banked now at hop latency 1, at the
+        #    end of the tick at latency 2 — the backward then reads the
+        #    ring as carried, which the schedule's consumer slack makes
+        #    exact)
+        brx = carry["brx"] if bank_late else bank_b(carry["brx"], bmsg, col)
         # 4. backward: recompute the chunk forward from the stashed
         #    input and pull (dp, dx) out of one VJP. The loss leg runs
         #    under a cond, so the (potentially head-sized) loss forward
@@ -535,8 +613,13 @@ def _1f1b_device_body(local_params, x_micro, loss_params, targets_micro,
             carry["dx"], dx.astype(carry["dx"].dtype),
             jnp.where(jnp.logical_and(b_on, col["b_first"] == 1),
                       col["b_micro"], M), 0)
+        if bank_late:
+            # hop latency 2: the hop results were not needed by any
+            # compute above — bank them for consumers at tick t+1 on
+            out["act"] = bank_f(out["act"], fmsg, col)
+            brx = bank_b(brx, bmsg, col)
         out["brx"] = brx
-        out["bmsg"] = jax.lax.ppermute(dx, axis, perm_bwd)
+        out["dxm"] = dx
         return out, None
 
     carry, _ = jax.lax.scan(tick, carry, cols)
@@ -547,13 +630,33 @@ def _1f1b_device_body(local_params, x_micro, loss_params, targets_micro,
     return carry["out"][None], carry["aux"][None]
 
 
+def default_overlap(packed: bool, interleave: int = 1,
+                    mesh: tp.Optional[Mesh] = None) -> bool:
+    """The `overlap=None` resolution of :func:`pipeline_1f1b`: packed
+    ring double-buffering pays off only where async collective-permute
+    exists (tpu/gpu) and only at interleave=1 (see
+    `schedules.build_1f1b_schedule`). The decision keys off the
+    platform of the mesh the pipeline actually runs on (a CPU
+    virtual-device mesh on a GPU host must NOT pay the latency-2 fill),
+    falling back to the default backend when no mesh is given.
+    Exported so stats reporters can name the exact schedule the
+    executable will run."""
+    if not packed or interleave != 1:
+        return False
+    platform = (mesh.devices.flat[0].platform if mesh is not None
+                else jax.default_backend())
+    return platform in ("tpu", "gpu")
+
+
 # ---------------------------------------------------------------------------
 # Measurement harness: `python -m flashy_tpu.parallel.pipeline` and the
 # bench.py `pipeline` leg both run this — GPipe vs 1F1B vs interleaved
-# 1F1B on a small (MoE) LM over a virtual-device 'pipe' mesh. Gates:
-# 1F1B gradients allclose to the GPipe oracle (MoE aux included), the
-# stash ring flat in M while GPipe's residency grows, interleaved
-# bubble strictly below GPipe at equal M, zero post-warm-up recompiles.
+# vs packed 1F1B on a small (MoE) LM over a virtual-device 'pipe' mesh.
+# Gates: 1F1B gradients allclose to the GPipe oracle (MoE aux
+# included), packed gradients BIT-identical to unpacked at equal
+# (S, M, v), packed step_ms strictly below unpacked, the stash ring
+# flat in M while GPipe's residency grows, interleaved bubble strictly
+# below GPipe at equal M, zero post-warm-up recompiles.
 # ---------------------------------------------------------------------------
 
 def _pipeline_leg(*, moe: bool, mesh, pipe: int, steps: int, num_micro: int,
@@ -601,6 +704,17 @@ def _pipeline_leg(*, moe: bool, mesh, pipe: int, steps: int, num_micro: int,
         "1f1b": dict(schedule="1f1b", interleave=1),
         f"1f1b-int{interleave}": dict(schedule="1f1b",
                                       interleave=interleave),
+        "packed_1f1b": dict(schedule="packed_1f1b", interleave=1),
+        f"packed_1f1b-int{interleave}": dict(schedule="packed_1f1b",
+                                             interleave=interleave),
+    }
+    # packed legs must be bit-identical to their unpacked twin at
+    # equal (S, M, v) — same per-microbatch compute, same f32
+    # accumulation order — and strictly faster (fewer ticks, same
+    # per-tick cost: the SPMD body always pays both lanes)
+    packed_pairs = {
+        "packed_1f1b": "1f1b",
+        f"packed_1f1b-int{interleave}": f"1f1b-int{interleave}",
     }
     leg: tp.Dict[str, tp.Any] = {"moe": moe, "oracle": "gpipe",
                                  "schedules": {}}
@@ -608,6 +722,7 @@ def _pipeline_leg(*, moe: bool, mesh, pipe: int, steps: int, num_micro: int,
     loss_by_leg: tp.Dict[str, float] = {}
     telemetry = get_telemetry()
     for name, spec in legs.items():
+        packed = spec["schedule"] == "packed_1f1b"
         grad_fn = pipelined_value_and_grad(
             model, mesh=mesh, num_microbatches=num_micro,
             interleave=spec["interleave"], schedule=spec["schedule"],
@@ -639,8 +754,10 @@ def _pipeline_leg(*, moe: bool, mesh, pipe: int, steps: int, num_micro: int,
                 leg["schedules"][name] = stats
                 continue
         else:
-            stats = schedule_stats(pipe, num_micro, spec["interleave"],
-                                   microbatch_shape=mb_shape)
+            stats = schedule_stats(
+                pipe, num_micro, spec["interleave"], packed=packed,
+                overlap=default_overlap(packed, spec["interleave"], mesh),
+                microbatch_shape=mb_shape)
             loss, grads = step_fn(variables, batches[0])
         device_sync(loss)  # compile + warm step done
         grads_by_leg[name] = jax.tree_util.tree_map(np.asarray, grads)
@@ -660,6 +777,16 @@ def _pipeline_leg(*, moe: bool, mesh, pipe: int, steps: int, num_micro: int,
             stats["grad_drift"] = drift
             stats["loss_delta"] = abs(loss_by_leg[name]
                                       - loss_by_leg["gpipe"])
+        if name in packed_pairs:
+            twin = packed_pairs[name]
+            stats["grads_bitwise_vs_unpacked"] = bool(
+                loss_by_leg[name] == loss_by_leg[twin] and all(
+                    np.array_equal(a, b) for a, b in zip(
+                        jax.tree_util.tree_leaves(grads_by_leg[name]),
+                        jax.tree_util.tree_leaves(grads_by_leg[twin]))))
+            stats["step_ms_vs_unpacked"] = round(
+                stats["step_ms"]
+                / max(leg["schedules"][twin]["step_ms"], 1e-9), 4)
         if telemetry is not None and "idle_ticks_per_device" in stats:
             telemetry.counter("pipeline/bubble",
                               idle_ticks_per_device=float(
@@ -669,6 +796,29 @@ def _pipeline_leg(*, moe: bool, mesh, pipe: int, steps: int, num_micro: int,
                               **{k: v for k, v in stats.items()
                                  if not isinstance(v, dict)}})
         leg["schedules"][name] = stats
+
+    # tick_efficiency: realized step_ms / the schedule-theoretic tick
+    # bound (num_ticks x per-tick cost). The calibration is the
+    # unpacked 1f1b leg AT THE SAME interleave — per-tick cost depends
+    # on the chunk size (v chunks of L/vS layers), but not on packing
+    # (the SPMD body pays both lanes every tick either way). 1.0 = the
+    # tick count fully explains the wall clock; a packed leg above 1.0
+    # quantifies the counted-vs-realized gap this metric exists to
+    # track. GPipe's differentiated scan executes the same 2(M+S-1)
+    # tick-equivalents as unpacked 1f1b, so it calibrates against it.
+    per_tick_ms = {}
+    for name, stats in leg["schedules"].items():
+        if name.startswith("1f1b") and stats.get("step_ms") \
+                and stats.get("num_ticks"):
+            per_tick_ms[stats["interleave"]] = (stats["step_ms"]
+                                                / stats["num_ticks"])
+    for name, stats in leg["schedules"].items():
+        ticks = stats.get("num_ticks") or (
+            2 * (num_micro + pipe - 1) if name == "gpipe" else None)
+        cal = per_tick_ms.get(stats.get("interleave"))
+        if ticks and cal and stats.get("step_ms"):
+            stats["tick_efficiency"] = round(
+                stats["step_ms"] / (ticks * cal), 4)
     return leg
 
 
@@ -679,14 +829,17 @@ def run_pipeline_bench(steps: int = 3, *, num_micro: int = 8,
                        batch: int = 16, moe: bool = True,
                        pipe: tp.Optional[int] = None
                        ) -> tp.Dict[str, tp.Any]:
-    """Measure the three pipeline schedules on dense and MoE LMs.
+    """Measure the five pipeline schedules on dense and MoE LMs.
 
     Returns a record with per-schedule ``bubble_frac``,
-    ``peak_stash_bytes``, ``step_ms`` and ``grad_drift`` (vs the GPipe
-    oracle; MoE aux in the objective on the ``moe`` leg), plus
-    ``recompiles`` (watchdog total past warm-up) and the stash-flatness
-    probe (the 1F1B ring at M vs 2M microbatches against GPipe's O(M)
-    growth).
+    ``peak_stash_bytes``, ``step_ms``, ``grad_drift`` (vs the GPipe
+    oracle; MoE aux in the objective on the ``moe`` leg) and
+    ``tick_efficiency`` (realized step_ms over the schedule-theoretic
+    tick bound, per-tick cost calibrated on the unpacked 1f1b leg),
+    plus ``grads_bitwise_vs_unpacked`` / ``step_ms_vs_unpacked`` on the
+    packed legs, ``recompiles`` (watchdog total past warm-up) and the
+    stash-flatness probe (the 1F1B ring at M vs 2M microbatches against
+    GPipe's O(M) growth).
     """
     from ..observability import RecompileWatchdog
     from .mesh import make_mesh
@@ -733,10 +886,12 @@ def run_pipeline_bench(steps: int = 3, *, num_micro: int = 8,
 
 def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     """`python -m flashy_tpu.parallel.pipeline [--steps N]`: run the
-    three-schedule measurement and print one JSON line; exit 1 when the
-    1F1B gradients drift from the GPipe oracle, the stash ring grows
-    with M, the interleaved bubble does not beat GPipe at equal M, or
-    any post-warm-up recompile was reported."""
+    five-schedule measurement and print one JSON line; exit 1 when the
+    1F1B gradients drift from the GPipe oracle, the packed gradients
+    are not bit-identical to unpacked 1F1B at equal (S, M, v), packed
+    realized step_ms is not strictly below unpacked, the stash ring
+    grows with M, the interleaved bubble does not beat GPipe at equal
+    M, or any post-warm-up recompile was reported."""
     import argparse
     import json
     import os
@@ -795,11 +950,26 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                     f"{tag}/{name} gradients drifted "
                     f"{stats['grad_drift']:.2e} from the "
                     f"{leg['oracle']} oracle")
-            if stats["interleave"] >= 2 and \
+            if not name.startswith("packed") and \
+                    stats["interleave"] >= 2 and \
                     stats["bubble_frac"] >= gpipe["bubble_frac"]:
                 problems.append(
                     f"{tag}/{name} bubble {stats['bubble_frac']} did not "
                     f"improve on GPipe's {gpipe['bubble_frac']} at equal M")
+            if name.startswith("packed"):
+                if not stats.get("grads_bitwise_vs_unpacked"):
+                    problems.append(
+                        f"{tag}/{name} gradients are not bit-identical "
+                        f"to the unpacked schedule at equal (S, M, v)")
+                if not stats.get("step_ms_vs_unpacked", 2.0) < 1.0:
+                    problems.append(
+                        f"{tag}/{name} realized step_ms did not beat the "
+                        f"unpacked schedule: ratio "
+                        f"{stats.get('step_ms_vs_unpacked')}")
+                if "tick_efficiency" not in stats:
+                    problems.append(
+                        f"{tag}/{name} tick_efficiency missing (bench "
+                        f"bookkeeping bug)")
     if not result["stash_flat_in_m"]:
         problems.append(
             f"1F1B stash grew with M: {result['stash_bytes_at_m']} -> "

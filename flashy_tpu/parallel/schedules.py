@@ -7,6 +7,10 @@
 # flight per device (O(S) stash, flat in M) at the same bubble, and
 # interleaved virtual stages (v non-adjacent layer chunks per device)
 # divide the bubble by the interleave factor: (S-1)/(v*M + S-1).
+# Packed 1F1B co-schedules the steady state's forward and backward
+# into ONE tick (the SPMD body executes both lanes every tick anyway),
+# cutting the step from 2(vM+S-1) to vM+(v+1)S-2 ticks at ~2x the
+# in-flight bound — still O(S), flat in M.
 #
 # Everything here is HOST-side and static: a schedule is a set of numpy
 # per-(tick, device) tables that the jitted pipeline program consumes as
@@ -26,6 +30,20 @@ import numpy as np
 FORWARD = "F"
 BACKWARD = "B"
 
+# Schedule spellings the validators and surfaces accept — the single
+# source of truth (models.pipelined.SCHEDULES and the example solver
+# both alias it).
+KNOWN_SCHEDULES = ("gpipe", "1f1b", "packed_1f1b")
+
+# The packed+forward rejection, shared verbatim by every surface that
+# raises it (validate_pipeline_args, pipeline_1f1b, pipelined_apply
+# spells its own variant with its alternatives).
+PACKED_FORWARD_ERROR = (
+    "schedule='packed_1f1b' has no forward-only spelling: packing "
+    "pairs each steady-state forward with a backward in the same "
+    "tick, which is meaningless without a backward lane. Use "
+    "schedule='1f1b' for pipelined forwards/inference.")
+
 
 def bubble_fraction(num_stages: int, num_micro: int,
                     interleave: int = 1) -> float:
@@ -38,6 +56,49 @@ def bubble_fraction(num_stages: int, num_micro: int,
     against idle ticks counted from the tables.
     """
     return (num_stages - 1) / (interleave * num_micro + num_stages - 1)
+
+
+def packed_ticks(num_stages: int, num_micro: int, interleave: int = 1,
+                 overlap: bool = False) -> int:
+    """Closed-form tick count of the packed 1F1B schedule.
+
+    Packing co-schedules the steady state's one-forward-one-backward
+    pair into a single tick (the SPMD body pays both lanes every tick
+    anyway), so the step shrinks from the unpacked `2(vM + S - 1)`
+    ticks to `vM + (v+1)S - 2`: the `vM` steady ticks advance one
+    microbatch each, and the fill/drain overhead is the forward chain
+    (`S-1` hops) plus the backward chain (`vS-1` hops) that bracket it.
+    At `interleave=1` this is the `M + 2(S-1)` of the classic packed
+    timeline. `overlap=True` (interleave=1 only) adds one tick of ring
+    latency per hop so the `ppermute` can run under the stage compute:
+    `M + 4(S-1)` — still below unpacked whenever `M > 2(S-1)`. Tests
+    pin these against ticks counted from the generated tables.
+    """
+    S, M, v = num_stages, num_micro, interleave
+    if overlap:
+        if v != 1:
+            raise ValueError(
+                "packed overlap is interleave=1 only (the doubled hop "
+                "latency exceeds the S-tick chunk group, see "
+                "build_1f1b_schedule)")
+        return M + 4 * (S - 1)
+    return v * M + (v + 1) * S - 2
+
+
+def packed_bubble_fraction(num_stages: int, num_micro: int,
+                           interleave: int = 1,
+                           overlap: bool = False) -> float:
+    """Idle-LANE fraction of the packed schedule: `1 - vM/T`.
+
+    Packed accounting is per lane (each tick has a forward and a
+    backward lane, both paid), so the useful fraction is `2vM` busy
+    lane-slots of the `2T` the device executes. This is the honest
+    wall-clock number: unlike the unpacked schedule-theoretic
+    `bubble_frac` (one work item per tick), a packed tick at fraction
+    `f` wastes `f` of the compute it actually pays for.
+    """
+    return 1.0 - (interleave * num_micro) / packed_ticks(
+        num_stages, num_micro, interleave, overlap)
 
 
 def gpipe_bubble_fraction(num_stages: int, num_micro: int) -> float:
@@ -69,14 +130,24 @@ def gpipe_stash_bytes(num_stages: int, num_micro: int,
 
 def validate_pipeline_args(num_stages: int, num_micro: int, batch: int,
                            interleave: int = 1,
-                           require_fill: bool = False) -> None:
+                           require_fill: bool = False,
+                           schedule: str = "1f1b",
+                           mode: str = "train") -> None:
     """Validate the (S, M, B, v) combination with actionable messages.
 
     `require_fill=True` adds the 1F1B constraints: M >= S (the steady
     state needs a full fill of in-flight microbatches) and, for
     interleave > 1, M divisible by S (chunk rotation walks microbatch
-    groups of size S).
+    groups of size S). `schedule='packed_1f1b'` shares every 1F1B
+    constraint but additionally rejects `mode='forward'`: packing
+    co-schedules each forward tick with a backward, so a forward-only
+    packed schedule has nothing to pack.
     """
+    if schedule not in KNOWN_SCHEDULES:
+        raise ValueError(f"schedule must be one of {KNOWN_SCHEDULES}, "
+                         f"got {schedule!r}")
+    if schedule == "packed_1f1b" and mode == "forward":
+        raise ValueError(PACKED_FORWARD_ERROR)
     if num_micro < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {num_micro}")
     if interleave < 1:
@@ -128,6 +199,16 @@ class PipelineSchedule:
     smallest ring buffers that hold every live activation/cotangent.
     For 1F1B at interleave=1 the stash depth is exactly S — the O(S)
     memory claim, checked by tests rather than asserted in prose.
+
+    `packed=True` marks the co-scheduled timeline: steady-state ticks
+    carry one forward AND one backward item for the same device, so
+    `idle_ticks` counts idle LANE-slots (each tick has two lanes, both
+    paid by the SPMD body) and `bubble_frac` divides by `2*T`.
+    `hop_latency=2` is the comm-overlap variant: consumers wait one
+    extra tick so a hop issued at the top of tick t (from tick t-1's
+    banked output) can run under tick t's stage compute; the jitted
+    body must then bank arrivals AFTER the compute (late banking), and
+    this field is what tells it to.
     """
     mode: str                    # 'train' | 'forward'
     num_stages: int
@@ -137,16 +218,26 @@ class PipelineSchedule:
     tables: tp.Mapping[str, np.ndarray]
     stash_depth: int
     brx_depth: int
-    idle_ticks: tp.Tuple[int, ...]   # per device, over the whole step
+    idle_ticks: tp.Tuple[int, ...]   # per device; lane-slots when packed
+    packed: bool = False
+    hop_latency: int = 1
 
     @property
     def num_chunks(self) -> int:
         return self.num_stages * self.interleave
 
     @property
+    def lanes(self) -> int:
+        """Work lanes per tick in the idle accounting: packed ticks
+        carry an F and a B lane; unpacked accounting stays the classic
+        one-work-item-per-tick (schedule-theoretic) convention."""
+        return 2 if self.packed else 1
+
+    @property
     def bubble_frac(self) -> float:
         """Idle fraction counted from the tables (not the formula)."""
-        return sum(self.idle_ticks) / (self.num_stages * self.num_ticks)
+        return sum(self.idle_ticks) / (
+            self.lanes * self.num_stages * self.num_ticks)
 
     @property
     def idle_ticks_per_device(self) -> float:
@@ -166,9 +257,10 @@ class PipelineSchedule:
     def stats(self, microbatch_shape: tp.Optional[tp.Sequence[int]] = None,
               dtype_size: int = 4) -> tp.Dict[str, tp.Any]:
         """One-stop summary for metrics/bench/demo reporting."""
+        base = "packed_1f1b" if self.packed else "1f1b"
         out: tp.Dict[str, tp.Any] = {
-            "schedule": "1f1b" if self.interleave == 1 else
-                        f"1f1b-interleave{self.interleave}",
+            "schedule": base if self.interleave == 1 else
+                        f"{base}-interleave{self.interleave}",
             "num_stages": self.num_stages,
             "num_micro": self.num_micro,
             "interleave": self.interleave,
@@ -179,6 +271,15 @@ class PipelineSchedule:
             "gpipe_bubble_frac": round(gpipe_bubble_fraction(
                 self.num_stages, self.num_micro), 6),
         }
+        if self.packed:
+            out["hop_latency"] = self.hop_latency
+            out["overlap"] = self.hop_latency > 1
+            # the wall-clock claim packing makes: ticks vs the unpacked
+            # schedule at equal (S, M, v) — per-tick cost is ~constant
+            # (the SPMD body always executes both lanes)
+            out["tick_ratio_vs_unpacked"] = round(
+                self.num_ticks / (2 * (self.interleave * self.num_micro
+                                       + self.num_stages - 1)), 6)
         if microbatch_shape is not None:
             out["peak_stash_bytes"] = self.stash_bytes(
                 microbatch_shape, dtype_size)
@@ -273,6 +374,67 @@ def _simulate(num_stages: int, orders, num_chunks: int
     return done, t
 
 
+def _simulate_packed(num_stages: int, orders, num_chunks: int,
+                     hop_latency: int
+                     ) -> tp.Tuple[tp.Dict[tp.Tuple[str, int, int], int], int]:
+    """Tick-accurate execution of the packed (co-scheduled) timeline.
+
+    The per-kind projections of the Megatron order become two
+    independent lanes per device; each tick a device runs the next
+    forward AND the next backward whose producers are satisfied, so the
+    steady state packs the 1F1B pair into one tick. Cross-device
+    producers must be done by `t - hop_latency` (`ppermute` delivery;
+    2 in overlap mode so the hop can hide under the consumer tick's
+    compute). The last chunk's backward depends on its own forward on
+    the SAME device, which the jitted body runs earlier in the same
+    tick — that dep is satisfied at `t` itself, which is what lets the
+    last stage run F(m) and B(m) together. Lanes run strictly in their
+    kind's order, so the f32 accumulation sequence per chunk is
+    IDENTICAL to the unpacked schedule — the bit-identical-gradients
+    guarantee is an ordering fact, not a numerics hope.
+    """
+    S, C, L = num_stages, num_chunks, hop_latency
+    lanes = {
+        FORWARD: [[it for it in o if it[0] == FORWARD] for o in orders],
+        BACKWARD: [[it for it in o if it[0] == BACKWARD] for o in orders],
+    }
+    ptr = {FORWARD: [0] * S, BACKWARD: [0] * S}
+    done: tp.Dict[tp.Tuple[str, int, int], int] = {}
+    never = 1 << 30
+    budget = 8 * sum(len(o) for o in orders) + 64
+    t = 0
+    while any(ptr[kind][d] < len(lanes[kind][d])
+              for kind in (FORWARD, BACKWARD) for d in range(S)):
+        if t > budget:
+            raise RuntimeError(
+                f"packed pipeline schedule simulation exceeded {budget} "
+                f"ticks — a generator bug produced an unsatisfiable order")
+        # Forward lane first: the body computes F before B within a
+        # tick, so a same-tick F(C-1, m) satisfies B(C-1, m) below.
+        for d in range(S):
+            if ptr[FORWARD][d] >= len(lanes[FORWARD][d]):
+                continue
+            _, k, m = lanes[FORWARD][d][ptr[FORWARD][d]]
+            c = k * S + d
+            if c == 0 or done.get((FORWARD, c - 1, m), never) <= t - L:
+                done[(FORWARD, c, m)] = t
+                ptr[FORWARD][d] += 1
+        for d in range(S):
+            if ptr[BACKWARD][d] >= len(lanes[BACKWARD][d]):
+                continue
+            _, k, m = lanes[BACKWARD][d][ptr[BACKWARD][d]]
+            c = k * S + d
+            if c == C - 1:
+                ready = done.get((FORWARD, c, m), never) <= t
+            else:
+                ready = done.get((BACKWARD, c + 1, m), never) <= t - L
+            if ready:
+                done[(BACKWARD, c, m)] = t
+                ptr[BACKWARD][d] += 1
+        t += 1
+    return done, t
+
+
 def _allocate_slots(intervals: tp.Sequence[tp.Tuple[tp.Any, int, int]]
                     ) -> tp.Tuple[tp.Dict[tp.Any, int], int]:
     """Greedy interval coloring: `(key, start, end)` inclusive ranges to
@@ -297,24 +459,53 @@ def _allocate_slots(intervals: tp.Sequence[tp.Tuple[tp.Any, int, int]]
 @functools.lru_cache(maxsize=32)
 def build_1f1b_schedule(num_stages: int, num_micro: int,
                         interleave: int = 1,
-                        mode: str = "train") -> PipelineSchedule:
+                        mode: str = "train",
+                        packed: bool = False,
+                        overlap: bool = False) -> PipelineSchedule:
     """Build (and cache) the full table set for a 1F1B schedule.
 
     `mode='train'` is the one-forward-one-backward schedule;
     `mode='forward'` is the forward half only (inference through the
-    same interleaved chunk placement). Deterministic in its arguments,
-    so the lru_cache can never serve a stale schedule.
+    same interleaved chunk placement). `packed=True` co-schedules the
+    steady state's F and B into one tick (train only — the tables gain
+    ticks with `f_do` and `b_do` both set, which the always-both-lanes
+    SPMD body turns into useful work in both lanes), shrinking the step
+    from `2(vM+S-1)` to `packed_ticks(S, M, v)` ticks. `overlap=True`
+    (packed, interleave=1 only) builds the schedule at hop latency 2 so
+    the jitted body can issue each tick's `ppermute` from the previous
+    tick's banked output and hide the hop under the stage compute; at
+    interleave > 1 the doubled latency exceeds the S-tick chunk group
+    and the round-trip would stall below the UNPACKED rate, so it is
+    rejected rather than silently slower. Deterministic in its
+    arguments, so the lru_cache can never serve a stale schedule.
     """
     if mode not in ("train", "forward"):
         raise ValueError(f"mode must be 'train' or 'forward', got {mode!r}")
+    if overlap and not packed:
+        raise ValueError("overlap=True is a packed-schedule feature "
+                         "(the unpacked tables stay at hop latency 1); "
+                         "pass packed=True as well")
+    if overlap and interleave > 1:
+        raise ValueError(
+            f"packed overlap (hop latency 2) supports interleave=1 only: "
+            f"at interleave={interleave} the hop round-trip of a "
+            f"virtual-stage wrap (2*S ticks) exceeds the S-tick chunk "
+            f"group, so the overlapped schedule would run BELOW the "
+            f"unpacked rate. Use overlap=False, or interleave=1.")
     S, M, v = num_stages, num_micro, interleave
     C = S * v
     # forward-only orders are plain sequential fills — no steady-state
     # 1F1B alternation, so M < S is legal there (small-batch inference)
     validate_pipeline_args(S, M, batch=M, interleave=v,
-                           require_fill=(mode == "train"))
+                           require_fill=(mode == "train" or packed),
+                           schedule="packed_1f1b" if packed else "1f1b",
+                           mode=mode)
+    hop_latency = 2 if overlap else 1
     orders = _device_orders(S, M, v, mode)
-    done, T = _simulate(S, orders, C)
+    if packed:
+        done, T = _simulate_packed(S, orders, C, hop_latency)
+    else:
+        done, T = _simulate(S, orders, C)
 
     fields = ["f_do", "f_chunk", "f_micro", "f_slot", "f_from_x", "f_last",
               "rxf_do", "rxf_slot"]
@@ -375,20 +566,26 @@ def build_1f1b_schedule(num_stages: int, num_micro: int,
                     tables["rxb_do"][arrive, d] = 1
                     tables["rxb_slot"][arrive, d] = brx_slots[(c, m)]
 
-    busy = tables["f_do"].sum(axis=0)
-    if mode == "train":
-        busy = busy + tables["b_do"].sum(axis=0)
-    idle = tuple(int(T - b) for b in busy)
+    if packed:
+        # lane accounting: each tick has an F and a B lane, both paid
+        busy = tables["f_do"].sum(axis=0) + tables["b_do"].sum(axis=0)
+        idle = tuple(int(2 * T - b) for b in busy)
+    else:
+        busy = tables["f_do"].sum(axis=0)
+        if mode == "train":
+            busy = busy + tables["b_do"].sum(axis=0)
+        idle = tuple(int(T - b) for b in busy)
     for name, table in tables.items():
         table.setflags(write=False)
     return PipelineSchedule(
         mode=mode, num_stages=S, num_micro=M, interleave=v, num_ticks=T,
         tables=tables, stash_depth=int(stash_depth), brx_depth=int(brx_depth),
-        idle_ticks=idle)
+        idle_ticks=idle, packed=packed, hop_latency=hop_latency)
 
 
 def schedule_stats(num_stages: int, num_micro: int, interleave: int = 1, *,
-                   mode: str = "train",
+                   mode: str = "train", packed: bool = False,
+                   overlap: bool = False,
                    microbatch_shape: tp.Optional[tp.Sequence[int]] = None,
                    dtype_size: int = 4) -> tp.Dict[str, tp.Any]:
     """Stats of the (cached) schedule — the host-side numbers the stage
@@ -405,5 +602,6 @@ def schedule_stats(num_stages: int, num_micro: int, interleave: int = 1, *,
             out["peak_stash_bytes"] = 0
             out["gpipe_stash_bytes"] = 0
         return out
-    schedule = build_1f1b_schedule(num_stages, num_micro, interleave, mode)
+    schedule = build_1f1b_schedule(num_stages, num_micro, interleave, mode,
+                                   packed=packed, overlap=overlap)
     return schedule.stats(microbatch_shape, dtype_size)

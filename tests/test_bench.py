@@ -181,7 +181,8 @@ def test_compact_line_fits_driver_tail_worst_case():
         "overhead_pct": 123.4, "steps_per_sec": 1234.56,
         "gib_per_sec": 123.45, "bus_bandwidth_gb_s": 1234.56,
         "bubble_frac_1f1b_int2": 0.157895, "stash_flat_in_m": True,
-        "recompiles": 0,
+        "recompiles": 0, "packed_step_ratio": 0.5717,
+        "packed_tick_eff": 0.8984, "packed_bitwise": True,
         "leg_platform": "tpu",
         "comparison": {"tokens_per_sec_per_chip": 39483.2},
     }

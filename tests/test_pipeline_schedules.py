@@ -13,7 +13,8 @@ from flashy_tpu.parallel import make_mesh
 from flashy_tpu.parallel.pipeline import pipeline, pipeline_1f1b
 from flashy_tpu.parallel.schedules import (
     build_1f1b_schedule, bubble_fraction, gpipe_bubble_fraction,
-    gpipe_stash_bytes, schedule_stats, validate_pipeline_args)
+    gpipe_stash_bytes, packed_bubble_fraction, packed_ticks,
+    schedule_stats, validate_pipeline_args)
 
 
 # ---------------------------------------------------------------------------
@@ -75,6 +76,123 @@ def test_stash_depth_flat_in_m_while_gpipe_grows():
     # interleaved rings are O(S*v), still flat in M
     assert build_1f1b_schedule(4, 8, 2).stash_depth == \
         build_1f1b_schedule(4, 16, 2).stash_depth
+
+
+# ---------------------------------------------------------------------------
+# packed (co-scheduled F+B) tables
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("num_stages,num_micro,interleave", [
+    (2, 4, 1), (4, 8, 1), (4, 16, 1), (8, 8, 1),
+    (2, 4, 2), (4, 8, 2), (4, 16, 2), (2, 8, 4), (8, 16, 2),
+])
+def test_packed_ticks_match_closed_form(num_stages, num_micro, interleave):
+    schedule = build_1f1b_schedule(num_stages, num_micro, interleave,
+                                   packed=True)
+    # counted ticks == the packed closed form vM + (v+1)S - 2, well
+    # below the unpacked 2(vM + S - 1)
+    assert schedule.num_ticks == packed_ticks(num_stages, num_micro,
+                                              interleave)
+    assert schedule.num_ticks < 2 * (interleave * num_micro
+                                     + num_stages - 1)
+    # lane accounting: 2vM busy lane-slots of 2T per device
+    assert all(idle == 2 * schedule.num_ticks - 2 * interleave * num_micro
+               for idle in schedule.idle_ticks)
+    assert schedule.bubble_frac == pytest.approx(
+        packed_bubble_fraction(num_stages, num_micro, interleave),
+        abs=1e-12)
+
+
+def test_packed_overlap_ticks_match_closed_form():
+    for num_stages, num_micro in ((2, 4), (4, 8), (4, 16), (8, 16)):
+        schedule = build_1f1b_schedule(num_stages, num_micro, packed=True,
+                                       overlap=True)
+        assert schedule.hop_latency == 2
+        assert schedule.num_ticks == packed_ticks(num_stages, num_micro,
+                                                  overlap=True)
+        assert schedule.num_ticks == num_micro + 4 * (num_stages - 1)
+
+
+@pytest.mark.parametrize("num_stages,num_micro,interleave,overlap", [
+    (4, 8, 1, False), (4, 8, 2, False), (2, 8, 4, False), (4, 8, 1, True),
+])
+def test_packed_tables_cover_all_work_exactly_once(num_stages, num_micro,
+                                                   interleave, overlap):
+    schedule = build_1f1b_schedule(num_stages, num_micro, interleave,
+                                   packed=True, overlap=overlap)
+    tables = schedule.tables
+    # every (device, chunk, micro) forward and backward appears exactly
+    # once; the tables hold at most one item per lane per tick by
+    # construction, so a double-write would collapse the set size
+    for do, chunk, micro in (("f_do", "f_chunk", "f_micro"),
+                             ("b_do", "b_chunk", "b_micro")):
+        seen = set()
+        for t in range(schedule.num_ticks):
+            for d in range(schedule.num_stages):
+                if tables[do][t, d]:
+                    key = (d, int(tables[chunk][t, d]),
+                           int(tables[micro][t, d]))
+                    assert key not in seen
+                    seen.add(key)
+        assert len(seen) == (schedule.num_stages * schedule.interleave
+                             * schedule.num_micro)
+        assert int(tables[do].sum()) == len(seen)
+    # packing actually happened: steady-state ticks carry BOTH lanes
+    both = (tables["f_do"] & tables["b_do"]).sum()
+    assert both > 0
+    # forward lane order per device is the unpacked Megatron order —
+    # the bit-identical-gradients guarantee is this ordering fact
+    unpacked = build_1f1b_schedule(num_stages, num_micro, interleave)
+    for do, chunk, micro in (("f_do", "f_chunk", "f_micro"),
+                             ("b_do", "b_chunk", "b_micro")):
+        for d in range(num_stages):
+            order_p = [(int(schedule.tables[chunk][t, d]),
+                        int(schedule.tables[micro][t, d]))
+                       for t in range(schedule.num_ticks)
+                       if schedule.tables[do][t, d]]
+            order_u = [(int(unpacked.tables[chunk][t, d]),
+                        int(unpacked.tables[micro][t, d]))
+                       for t in range(unpacked.num_ticks)
+                       if unpacked.tables[do][t, d]]
+            assert order_p == order_u
+
+
+def test_packed_stash_flat_in_m():
+    mb_shape = (2, 16, 8)
+    base = build_1f1b_schedule(4, 8, packed=True)
+    doubled = build_1f1b_schedule(4, 16, packed=True)
+    quadrupled = build_1f1b_schedule(4, 32, packed=True)
+    # the packed in-flight bound is ~2S (the fill runs one forward per
+    # tick for the full 2(S-1) warmup) — larger than unpacked 1F1B's S,
+    # still O(S) and FLAT in the microbatch count
+    assert base.stash_depth == 2 * 4 - 1
+    assert doubled.stash_depth == base.stash_depth
+    assert quadrupled.stash_depth == base.stash_depth
+    assert doubled.stash_bytes(mb_shape) == base.stash_bytes(mb_shape)
+    assert build_1f1b_schedule(4, 8, 2, packed=True).stash_depth == \
+        build_1f1b_schedule(4, 16, 2, packed=True).stash_depth
+
+
+def test_packed_validation_messages():
+    # the same actionable divisor/fill errors as unpacked 1F1B...
+    with pytest.raises(ValueError, match="divisors of the batch"):
+        validate_pipeline_args(4, 3, batch=8, schedule="packed_1f1b")
+    with pytest.raises(ValueError, match="num_microbatches >= num_stages"):
+        validate_pipeline_args(4, 2, batch=8, require_fill=True,
+                               schedule="packed_1f1b")
+    # ...plus the packed-specific forward-only rejection naming '1f1b'
+    with pytest.raises(ValueError, match="schedule='1f1b'"):
+        validate_pipeline_args(4, 8, batch=16, schedule="packed_1f1b",
+                               mode="forward")
+    with pytest.raises(ValueError, match="schedule must be one of"):
+        validate_pipeline_args(4, 8, batch=16, schedule="pipedream")
+    # overlap is packed-only and interleave=1 only
+    with pytest.raises(ValueError, match="packed=True"):
+        build_1f1b_schedule(4, 8, overlap=True)
+    with pytest.raises(ValueError, match="interleave=1 only"):
+        build_1f1b_schedule(4, 8, 2, packed=True, overlap=True)
+    with pytest.raises(ValueError, match="forward-only"):
+        build_1f1b_schedule(4, 8, mode="forward", packed=True)
 
 
 def test_schedule_stats_single_stage_degenerate():
@@ -228,6 +346,69 @@ def test_pipeline_1f1b_forward_allows_small_m():
                       mesh=mesh, num_microbatches=2)
 
 
+@pytest.mark.parametrize("interleave,overlap", [(1, False), (2, False),
+                                                (1, True)])
+def test_packed_grads_bit_identical_to_unpacked(interleave, overlap):
+    # Packing only reschedules: same per-microbatch compute, same f32
+    # accumulation order per chunk — so the gradients (and loss) must
+    # be BIT-identical to the unpacked 1F1B schedule, not just close.
+    mesh = make_mesh({"pipe": 4, "data": 2})
+    num_micro, aux_weight = 8, 0.05
+    params, x, lp, targets, stage_fn, loss_fn = _simple_problem(
+        4 * interleave)
+    sharded = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+
+    def run(packed):
+        return jax.jit(lambda p, xx: pipeline_1f1b(
+            stage_fn, p, xx, loss_fn=loss_fn, loss_params=lp,
+            targets=targets, mesh=mesh, num_microbatches=num_micro,
+            interleave=interleave, has_aux=True, aux_weight=aux_weight,
+            packed=packed, overlap=overlap if packed else None))(sharded, x)
+
+    (loss_u, aux_u), grads_u = run(packed=False)
+    (loss_p, aux_p), grads_p = run(packed=True)
+    assert float(loss_p) == float(loss_u)
+    assert float(aux_p) == float(aux_u)
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(grads_p),
+            jax.tree_util.tree_leaves_with_path(grads_u)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            jax.tree_util.keystr(path)
+
+
+def test_packed_rejects_forward_only():
+    mesh = make_mesh({"pipe": 4, "data": 2})
+    params, x, _, _, stage_fn, _ = _simple_problem(4)
+
+    def fwd(p, h):
+        return stage_fn(p, h)[0]
+
+    sharded = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+    with pytest.raises(ValueError, match="schedule='1f1b'"):
+        pipeline_1f1b(fwd, sharded, x, mesh=mesh, num_microbatches=8,
+                      packed=True)
+    # overlap without packed is a contradiction, not a silent no-op
+    with pytest.raises(ValueError, match="packed=True"):
+        pipeline_1f1b(fwd, sharded, x, mesh=mesh, num_microbatches=8,
+                      loss_fn=lambda lp, h: (h ** 2).mean(), overlap=True)
+
+
+def test_packed_zero_recompiles_via_watchdog():
+    from flashy_tpu.observability import RecompileWatchdog
+    mesh = make_mesh({"pipe": 4, "data": 2})
+    params, x, lp, targets, stage_fn, loss_fn = _simple_problem(4)
+    sharded = jax.device_put(params, NamedSharding(mesh, P("pipe")))
+    watchdog = RecompileWatchdog(warmup=1)
+    step = watchdog.watch(jax.jit(lambda p, xx: pipeline_1f1b(
+        stage_fn, p, xx, loss_fn=loss_fn, loss_params=lp, targets=targets,
+        mesh=mesh, num_microbatches=4, has_aux=True, aux_weight=0.05,
+        packed=True)), name="packed1f1b")
+    for shift in range(3):
+        step(sharded, x + shift * 0.1)
+    assert watchdog.counts["packed1f1b"]["compiles"] == 1
+    assert watchdog.summary() == {}
+
+
 def test_pipeline_1f1b_single_stage_degenerate():
     mesh = make_mesh({"data": -1})  # pipe axis size 1
     params, x, lp, targets, stage_fn, loss_fn = _simple_problem(2)
@@ -369,6 +550,28 @@ def test_pipelined_value_and_grad_moe_aux_matches_sequential():
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("moe", [False, True])
+def test_pipelined_value_and_grad_packed_bit_identical(moe):
+    # The LM training surface: schedule='packed_1f1b' must return the
+    # exact bits of schedule='1f1b' at equal (S, M, v) — including the
+    # reassembled tied-embedding gradient and the MoE aux objective.
+    from flashy_tpu.models.pipelined import pipelined_value_and_grad
+    mesh, model, tokens, variables, params = _lm_setup(moe=moe)
+    kwargs = dict(mesh=mesh, num_microbatches=4,
+                  aux_weight=0.01 if moe else 0.0)
+    loss_u, grads_u = jax.jit(pipelined_value_and_grad(
+        model, schedule="1f1b", **kwargs))(params, tokens)
+    loss_p, grads_p = jax.jit(pipelined_value_and_grad(
+        model, schedule="packed_1f1b", **kwargs))(params, tokens)
+    assert float(loss_p) == float(loss_u)
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(grads_p),
+            jax.tree_util.tree_leaves_with_path(grads_u)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            jax.tree_util.keystr(path)
+
+
+@pytest.mark.slow
 def test_pipelined_apply_1f1b_forward_matches_gpipe():
     from flashy_tpu.models.pipelined import pipelined_apply
     mesh, model, tokens, variables, params = _lm_setup(moe=True)
@@ -400,6 +603,10 @@ def test_pipelined_apply_interleave_validation():
     with pytest.raises(ValueError, match="schedule must be one of"):
         pipelined_apply(model, variables, tokens, mesh=mesh,
                         schedule="pipedream")
+    # packed has no forward-only schedule; the message routes to '1f1b'
+    with pytest.raises(ValueError, match="schedule='1f1b'"):
+        pipelined_apply(model, variables, tokens, mesh=mesh,
+                        schedule="packed_1f1b")
 
 
 @pytest.mark.slow

@@ -681,3 +681,40 @@ def test_pipeline_tick_fault_surfaces_cleanly():
         assert injector.hits("pipeline.tick") == 1
     finally:
         chaos.uninstall()  # strict: raises if the armed rule never fired
+
+
+def test_pipeline_packed_tick_fault_surfaces_cleanly():
+    """Same contract as `pipeline.tick` for the packed co-scheduled
+    timeline: the host-side `pipeline.packed_tick` site fires before
+    any collective launches, so an armed fault raises `InjectedFault`
+    cleanly and can never hang the ring mid-schedule — and the strict
+    injector proves the rule actually fired."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from flashy_tpu.parallel import make_mesh
+    from flashy_tpu.parallel.pipeline import pipeline_1f1b
+
+    mesh = make_mesh({"pipe": 2, "data": 4})
+    params = jax.device_put({"w": jnp.full((2, 4, 4), 0.1, jnp.float32)},
+                            NamedSharding(mesh, P("pipe")))
+    x = jnp.ones((4, 4), jnp.float32)
+
+    def step():
+        # driven eagerly: the host-side fault site ticks once per call
+        return pipeline_1f1b(
+            lambda p, h: jnp.tanh(h @ p["w"]), params, x,
+            loss_fn=lambda lp, h: (h ** 2).mean(), mesh=mesh,
+            num_microbatches=2, packed=True)
+
+    injector = chaos.install(strict=True)
+    try:
+        injector.fail_at("pipeline.packed_tick", call=2)
+        loss, grads = step()  # call 1: packed schedule runs normally
+        assert np.isfinite(float(loss))
+        with pytest.raises(chaos.InjectedFault):
+            step()
+        assert injector.hits("pipeline.packed_tick") == 1
+    finally:
+        chaos.uninstall()  # strict: raises if the armed rule never fired
