@@ -28,6 +28,17 @@ analyze:
 		echo "mypy not installed; skipping analyzer type check"; \
 	fi
 
+# Trace-level program audit (flashy_tpu.analysis.trace): build the
+# zero/pipeline/serve demo programs on 8 virtual CPU devices and run
+# the FT101-FT104 auditors — compiled sharding layouts + collective
+# mix (FT101), pipeline tick tables model-checked against the traced
+# ppermute ring (FT102), jit-signature retrace risk (FT103), and
+# FLOP-priced idle-lane accounting (FT104). Exit 1 on any NEW finding
+# vs the committed .analysis-trace-baseline.json.
+analyze-trace:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m flashy_tpu.analysis --trace
+
 tests-all:
 	python -m pytest tests -x -q
 
@@ -125,4 +136,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all analyze coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo zero-demo pipeline-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all analyze analyze-trace coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo zero-demo pipeline-demo datapipe-demo docs native dist

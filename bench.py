@@ -998,7 +998,8 @@ def bench_pipeline(jax, on_tpu: bool):
         key = name.replace("-", "_")
         for field in ("bubble_frac", "peak_stash_bytes", "step_ms",
                       "grad_drift", "num_ticks", "tick_efficiency",
-                      "step_ms_vs_unpacked", "grads_bitwise_vs_unpacked"):
+                      "step_ms_vs_unpacked", "grads_bitwise_vs_unpacked",
+                      "dead_compute_frac"):
             if field in stats:
                 result[f"{field}_{key}"] = stats[field]
     # short aliases for the stdout line's whitelist — the driver-tail
@@ -1011,6 +1012,10 @@ def bench_pipeline(jax, on_tpu: bool):
         result["packed_tick_eff"] = packed["tick_efficiency"]
     if "grads_bitwise_vs_unpacked" in packed:
         result["packed_bitwise"] = packed["grads_bitwise_vs_unpacked"]
+    # FT104's scalar (flashy_tpu.analysis.trace.dead_compute): the
+    # FLOP-priced masked-idle-lane fraction packing exists to narrow
+    if "dead_compute_frac" in packed:
+        result["packed_dead_compute"] = packed["dead_compute_frac"]
     log(f"pipeline: bubble gpipe={result.get('bubble_frac_gpipe')} "
         f"1f1b-int2={result.get('bubble_frac_1f1b_int2')}; packed step "
         f"{result.get('step_ms_packed_1f1b')}ms vs 1f1b "
@@ -1264,7 +1269,8 @@ _COMPACT_KEYS = {
     "zero": ("opt_bytes_ratio_zero1", "step_ms_zero1", "step_ms_replicated",
              "recompiles"),
     "pipeline": ("bubble_frac_1f1b_int2", "stash_flat_in_m", "recompiles",
-                 "packed_step_ratio", "packed_tick_eff", "packed_bitwise"),
+                 "packed_step_ratio", "packed_tick_eff", "packed_bitwise",
+                 "packed_dead_compute"),
     "ring": ("overhead_pct",),
     "datapipe": ("tokens_per_sec", "packing_efficiency"),
     "gan": ("steps_per_sec",),

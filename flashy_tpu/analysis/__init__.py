@@ -34,6 +34,13 @@ Suppress a single line with ``# flashy: noqa[FT001]`` (or a blanket
 ``# flashy: noqa``); grandfather existing findings into the committed
 baseline with ``--write-baseline`` — the CI gate is *no new
 violations*.
+
+The TRACE half — :mod:`flashy_tpu.analysis.trace` (FT101-FT104,
+``--trace`` / ``make analyze-trace``) — audits what jax actually
+built: compiled sharding layouts + collective mix, pipeline tick
+tables model-checked against the traced ppermute ring, jit-signature
+retrace risk, and FLOP-priced idle-lane accounting. It needs jax, so
+it is imported lazily and this package stays stdlib-only importable.
 """
 import typing as tp
 

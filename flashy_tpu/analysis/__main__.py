@@ -3,7 +3,10 @@
 # findings (each printed with its stable code and autofix hint).
 # Exit 2: usage/internal error. `--write-registry` regenerates the
 # committed fault-site registry; `--write-baseline` re-grandfathers
-# the current findings.
+# the current findings. `--trace` switches to the trace half
+# (FT101-FT104, `make analyze-trace`): it imports jax, builds the
+# zero/pipeline/serve demo programs on the current backend, runs the
+# trace auditors, and gates against the committed trace baseline.
 """CLI for the project-aware static analyzer."""
 from pathlib import Path
 import argparse
@@ -53,7 +56,23 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                         help="describe every checker and exit")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="print only the summary line")
+    parser.add_argument("--trace", action="store_true",
+                        help="run the trace-level auditors (FT101-FT104) "
+                             "over the demo programs instead of the AST "
+                             "checkers (requires jax + a multi-device "
+                             "backend; see `make analyze-trace`)")
+    parser.add_argument("--legs", default=None, metavar="zero,pipeline",
+                        help="--trace only: comma-separated demo legs "
+                             "(default: zero,pipeline,serve)")
     args = parser.parse_args(argv)
+
+    if args.trace:
+        return _trace_main(args)
+
+    if args.legs is not None:
+        print("error: --legs only applies to --trace runs",
+              file=sys.stderr)
+        return 2
 
     if args.list_checks:
         for checker in ALL_CHECKERS:
@@ -126,6 +145,77 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
             print(finding.render())
     grandfathered = len(findings) - len(fresh)
     summary = (f"flashy_tpu.analysis: {len(files)} files, "
+               f"{len(fresh)} new finding(s)")
+    if grandfathered:
+        summary += f", {grandfathered} baselined"
+    if suppressed:
+        summary += f", {len(suppressed)} suppressed (noqa)"
+    print(summary)
+    return 1 if fresh else 0
+
+
+def _trace_main(args: tp.Any) -> int:
+    """The trace half's gate: sweep the demo programs, compare against
+    the committed trace baseline. Imported lazily — the AST half must
+    stay runnable (and importable) without jax."""
+    if args.paths:
+        print("error: --trace audits the demo programs, not source "
+              "paths; drop the positional arguments (scope with --legs "
+              "/ --select instead)", file=sys.stderr)
+        return 2
+    if args.write_registry:
+        print("error: --write-registry regenerates the AST half's "
+              "fault-site registry; run it without --trace",
+              file=sys.stderr)
+        return 2
+    try:
+        from . import trace
+    except ImportError as exc:
+        print(f"error: --trace needs jax ({exc})", file=sys.stderr)
+        return 2
+
+    if args.list_checks:
+        for auditor in trace.ALL_AUDITORS:
+            print(f"{auditor.code} {auditor.name}: {auditor.explain}")
+        return 0
+
+    root = (args.root or _default_root()).resolve()
+    try:
+        auditors = (list(trace.ALL_AUDITORS) if args.select is None
+                    else [trace.auditor_by_code(code.strip())
+                          for code in args.select.split(",") if code.strip()])
+    except KeyError as exc:
+        print(f"error: unknown auditor code {exc.args[0]!r}",
+              file=sys.stderr)
+        return 2
+    legs = (trace.SWEEP_LEGS if args.legs is None
+            else tuple(leg.strip() for leg in args.legs.split(",")
+                       if leg.strip()))
+    try:
+        programs = trace.demo_programs(legs)
+    except (RuntimeError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    findings, suppressed = trace.run_auditors(programs, auditors)
+    baseline_path = (args.baseline
+                     or root / trace.DEFAULT_TRACE_BASELINE_NAME)
+    if args.write_baseline:
+        trace.save_trace_baseline(baseline_path, findings)
+        print(f"wrote {baseline_path} ({len(findings)} grandfathered "
+              "findings)")
+        return 0
+
+    if args.no_baseline:
+        fresh = list(findings)
+    else:
+        fresh = trace.new_trace_findings(
+            findings, trace.load_trace_baseline(baseline_path))
+    if not args.quiet:
+        for finding in fresh:
+            print(finding.render())
+    grandfathered = len(findings) - len(fresh)
+    summary = (f"flashy_tpu.analysis --trace: {len(programs)} programs, "
                f"{len(fresh)} new finding(s)")
     if grandfathered:
         summary += f", {grandfathered} baselined"

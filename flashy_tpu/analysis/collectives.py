@@ -16,11 +16,15 @@ from .core import Checker, Finding, ProjectIndex, SourceFile, literal_str
 
 __all__ = ["CollectiveAccountingChecker", "COLLECTIVE_OPS"]
 
-# Kept in sync with parallel.accounting.COLLECTIVE_OPS by a unit test
-# (importing it here would drag jax into the stdlib-only linter).
+# A SUPERSET of parallel.accounting.COLLECTIVE_OPS, pinned by a unit
+# test (importing it here would drag jax into the stdlib-only linter).
+# Beyond the HLO spellings the accounting module counts, the checker
+# also knows the jaxpr-level `ppermute` (PR 10/11's pipeline ring): a
+# hand-rolled `"ppermute"` scrape over a jaxpr/HLO dump has the same
+# async double-count failure mode as its `collective-permute` lowering.
 COLLECTIVE_OPS = ("ragged-all-to-all", "all-gather", "all-reduce",
-                  "reduce-scatter", "collective-permute", "all-to-all",
-                  "collective-broadcast")
+                  "reduce-scatter", "collective-permute", "ppermute",
+                  "all-to-all", "collective-broadcast")
 
 # The accounting module itself, its dedicated tests, and this package
 # (whose sources necessarily spell the op names) are the convention's

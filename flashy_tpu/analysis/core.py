@@ -237,9 +237,16 @@ def extract_fault_sites(file: SourceFile,
     for node in ast.walk(file.tree):
         if not isinstance(node, ast.Call) or call_name(node) != "fault_point":
             continue
-        if not node.args:
+        # the site is the first positional arg OR the `site=` keyword
+        # (both spellings are legal on chaos.fault_point's signature)
+        arg: tp.Optional[ast.AST] = node.args[0] if node.args else None
+        if arg is None:
+            for keyword in node.keywords:
+                if keyword.arg == "site":
+                    arg = keyword.value
+                    break
+        if arg is None:
             continue
-        arg = node.args[0]
         value = literal_str(arg)
         if value is not None:
             sites.add(value)

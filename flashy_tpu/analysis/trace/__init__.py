@@ -1,0 +1,85 @@
+# The analyzer's second half: auditors for the TRACED AND COMPILED
+# program. The AST half (FT001-FT006) catches what source text shows;
+# the invariants the perf claims actually ride on — zero1/fsdp state
+# truly sharded 1/N per chip, pipeline ppermute sequences
+# deadlock-free and microbatch-exact across ranks, zero post-warm-up
+# retraces, idle-lane FLOPs no worse than the schedule promises — live
+# past tracing, where a silent sharding-propagation fallback or a
+# reordered collective is invisible to any AST pass. This package
+# REQUIRES jax (it inspects jaxprs and jax.stages.Compiled objects) and
+# is therefore imported lazily by `flashy_tpu.analysis`, which must
+# stay stdlib-only importable.
+"""flashy_tpu.analysis.trace — trace-level program audit (FT101-FT104).
+
+Run the demo sweep with ``python -m flashy_tpu.analysis --trace`` (or
+``make analyze-trace``). Auditors:
+
+* **FT101 sharding-audit** — declared-sharded leaves of a compiled
+  executable must not fall back to replicated layouts; the HLO
+  collective mix must match the program's promise (e.g. a zero1 update
+  must keep its grad reduction and must not all-gather the opt state);
+  live per-device bytes must show the ~1/N shard.
+* **FT102 collective-order** — the pipeline schedule's tick tables are
+  model-checked against the ppermute ring extracted from the traced
+  jaxpr: every hop matched to its producer, no rank-divergent order,
+  no stash-slot clobbers, async ``-start``/``-done`` pairs matched.
+  The static complement of the packed-1F1B bitwise gradient gate — the
+  two must agree, and the model check names the exact (tick, device)
+  of the first mismatch.
+* **FT103 recompile-risk** — representative call signatures must
+  collapse onto the warm-up budget of jit cache entries; Python
+  scalars flowing into traced shapes are flagged as retrace-per-value.
+  The pre-flight complement of the runtime ``RecompileWatchdog``.
+* **FT104 dead-compute** — the FLOP-priced idle-lane fraction of a
+  schedule's tick tables must not regress past the canonical
+  generator's at the same (S, M, v, packed).
+
+Gate semantics match the AST half: findings are fingerprinted
+(program label + stable detail key) and compared against the committed
+``.analysis-trace-baseline.json``; the CI gate is *no NEW findings*.
+Per-program suppression uses ``AuditProgram.noqa``.
+"""
+import typing as tp
+
+from .core import (AuditProgram, TraceAuditor, TraceFinding,  # noqa: F401
+                   DEFAULT_TRACE_BASELINE_NAME, jaxpr_flops,
+                   load_trace_baseline, new_trace_findings, run_auditors,
+                   save_trace_baseline, trace_fingerprint)
+from .sharding_audit import ShardingAuditor
+from .collective_order import (CollectiveOrderAuditor,  # noqa: F401
+                               extract_ppermutes, model_check_schedule)
+from .recompile_risk import RecompileRiskAuditor, call_signature  # noqa: F401
+from .dead_compute import DeadComputeAuditor, dead_compute_stats  # noqa: F401
+from .sweep import SWEEP_LEGS, demo_programs  # noqa: F401
+
+__all__ = [
+    "ALL_AUDITORS", "AuditProgram", "TraceAuditor", "TraceFinding",
+    "auditor_by_code", "audit_programs", "call_signature",
+    "dead_compute_stats", "demo_programs", "extract_ppermutes",
+    "jaxpr_flops", "model_check_schedule", "run_auditors",
+]
+
+ALL_AUDITORS: tp.Tuple[TraceAuditor, ...] = (
+    ShardingAuditor(),
+    CollectiveOrderAuditor(),
+    RecompileRiskAuditor(),
+    DeadComputeAuditor(),
+)
+
+
+def auditor_by_code(code: str) -> TraceAuditor:
+    for auditor in ALL_AUDITORS:
+        if auditor.code == code:
+            return auditor
+    raise KeyError(code)
+
+
+def audit_programs(programs: tp.Sequence[AuditProgram],
+                   select: tp.Optional[tp.Sequence[str]] = None,
+                   ) -> tp.List[TraceFinding]:
+    """Programmatic one-shot: active (non-suppressed) findings for
+    `programs`, optionally restricted to auditor `select`."""
+    auditors = (list(ALL_AUDITORS) if select is None
+                else [auditor_by_code(code) for code in select])
+    findings, _ = run_auditors(programs, auditors)
+    return findings

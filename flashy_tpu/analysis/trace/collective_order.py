@@ -1,0 +1,371 @@
+# FT102 — collective ordering. A pipeline schedule is a distributed
+# program whose correctness is a RELATIONAL property: every ppermute
+# hop a consumer tick banks must have been produced on the right
+# neighbor at exactly the right earlier tick, for the right (chunk,
+# microbatch), into a stash slot nothing overwrites before the read.
+# The packed-1F1B demo gate checks this END-TO-END (bitwise gradient
+# equality), which proves a violation happened but not WHERE; this
+# auditor model-checks the same facts statically — replaying the tick
+# tables against the ring topology extracted from the traced jaxpr —
+# and names the exact (tick, device) of the first broken dependency
+# (arXiv 2412.14374's per-stage collective schedules fail exactly this
+# way: one rank's program order diverges and the whole ring deadlocks
+# or silently mixes microbatches). The two gates must always agree;
+# tests pin that agreement on a passing schedule AND a deliberately
+# corrupted tick table.
+"""FT102 collective-order: model-check tick tables vs traced ppermutes."""
+import re
+import typing as tp
+
+import numpy as np
+
+from .core import AuditProgram, TraceAuditor, TraceFinding, hlo_text
+from .core import iter_subjaxprs
+
+__all__ = ["CollectiveOrderAuditor", "extract_ppermutes",
+           "model_check_schedule"]
+
+
+def extract_ppermutes(jaxpr: tp.Any
+                      ) -> tp.List[tp.Tuple[tp.Tuple[str, ...],
+                                            tp.Tuple[tp.Tuple[int, int], ...]]]:
+    """`(axis_names, perm)` of every ppermute in the (closed) jaxpr, in
+    program order, recursing through scan/cond/pjit/shard_map bodies —
+    the per-device collective sequence as jax traced it."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    out: tp.List[tp.Tuple[tp.Tuple[str, ...],
+                          tp.Tuple[tp.Tuple[int, int], ...]]] = []
+
+    def walk(jx: tp.Any) -> None:
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "ppermute":
+                axes = eqn.params.get("axis_name")
+                if not isinstance(axes, tuple):
+                    axes = (axes,)
+                perm = tuple((int(a), int(b))
+                             for a, b in eqn.params.get("perm", ()))
+                out.append((tuple(str(a) for a in axes), perm))
+        for sub in iter_subjaxprs(jx):
+            walk(sub)
+
+    walk(inner)
+    return out
+
+
+def _finding(code: str, label: str, key: str, message: str,
+             hint: str = "") -> TraceFinding:
+    return TraceFinding(code, label, key, message, hint)
+
+
+def model_check_schedule(schedule: tp.Any
+                         ) -> tp.List[tp.Tuple[str, str]]:
+    """Replay a `PipelineSchedule`'s tables; return `(key, message)`
+    defects, dependency mismatches reported at the FIRST broken
+    (tick, device) only (later mismatches are cascade noise).
+
+    Checks: completeness (every (chunk, microbatch) forward/backward
+    exactly once on its owning device), hop matching (each consumed
+    activation/cotangent was produced on the correct ring neighbor at
+    arrival-1 and banked into the slot the consumer reads, respecting
+    the schedule's hop latency), stash-slot liveness (nothing
+    overwrites a banked value before its read), and rank agreement
+    (rx rows must match a real producer on the neighbor — no orphan
+    banking of garbage lanes).
+    """
+    t_tab = schedule.tables
+    S, v, M = schedule.num_stages, schedule.interleave, schedule.num_micro
+    C, T, L = S * v, schedule.num_ticks, schedule.hop_latency
+    train = schedule.mode == "train"
+    defects: tp.List[tp.Tuple[str, str]] = []
+
+    def tab(name: str) -> np.ndarray:
+        return np.asarray(t_tab[name])
+
+    # --- event maps + completeness -----------------------------------
+    done_f: tp.Dict[tp.Tuple[int, int], int] = {}
+    done_b: tp.Dict[tp.Tuple[int, int], int] = {}
+    for kind, do, chunk, micro, done in (
+            ("forward", "f_do", "f_chunk", "f_micro", done_f),
+            ("backward", "b_do", "b_chunk", "b_micro", done_b)):
+        if not train and kind == "backward":
+            continue
+        for t in range(T):
+            for d in range(S):
+                if tab(do)[t, d] != 1:
+                    continue
+                c = int(tab(chunk)[t, d]) * S + d
+                m = int(tab(micro)[t, d])
+                event = (c, m)
+                if event in done:
+                    defects.append((
+                        f"duplicate-{kind}:c{c}m{m}",
+                        f"{kind} of chunk {c} microbatch {m} scheduled "
+                        f"twice (ticks {done[event]} and {t} on device "
+                        f"{d})"))
+                done[event] = t
+        missing = [(c, m) for c in range(C) for m in range(M)
+                   if (c, m) not in done]
+        if missing:
+            defects.append((
+                f"missing-{kind}",
+                f"{len(missing)} {kind} item(s) never scheduled, first: "
+                f"chunk {missing[0][0]} microbatch {missing[0][1]}"))
+        if not train:
+            break
+    if defects:
+        return defects  # dependency replay over incomplete tables is noise
+
+    # --- writes per (device, slot) for liveness checks ---------------
+    # act ring: rx banks + from-x self-writes; brx ring: rx banks.
+    act_writes: tp.Dict[int, tp.List[tp.Tuple[int, int, str]]] = {
+        d: [] for d in range(S)}
+    brx_writes: tp.Dict[int, tp.List[tp.Tuple[int, int, str]]] = {
+        d: [] for d in range(S)}
+    for t in range(T):
+        for d in range(S):
+            if tab("rxf_do")[t, d] == 1:
+                act_writes[d].append((t, int(tab("rxf_slot")[t, d]), "rxf"))
+            if tab("f_do")[t, d] == 1 and tab("f_from_x")[t, d] == 1:
+                act_writes[d].append((t, int(tab("f_slot")[t, d]), "from_x"))
+            if train and tab("rxb_do")[t, d] == 1:
+                brx_writes[d].append((t, int(tab("rxb_slot")[t, d]), "rxb"))
+
+    def overwritten(writes, d, slot, after, upto, own):
+        return [w for w in writes[d]
+                if w[1] == slot and after < w[0] <= upto and w[0] != own]
+
+    # --- dependency replay, first mismatch wins ----------------------
+    for t in range(T):
+        for d in range(S):
+            # orphan rx rows: a bank with no matching producer on the
+            # neighbor one tick earlier is rank-divergent ordering
+            if tab("rxf_do")[t, d] == 1:
+                src = (d - 1) % S
+                if t == 0 or tab("f_do")[t - 1, src] != 1:
+                    return defects + [(
+                        f"orphan-rxf:t{t}d{d}",
+                        f"tick {t} device {d}: banks a forward arrival "
+                        f"but device {src} ran no forward at tick "
+                        f"{t - 1} — the ppermute delivers a garbage "
+                        f"lane")]
+            if train and tab("rxb_do")[t, d] == 1:
+                src = (d + 1) % S
+                if t == 0 or tab("b_do")[t - 1, src] != 1:
+                    return defects + [(
+                        f"orphan-rxb:t{t}d{d}",
+                        f"tick {t} device {d}: banks a cotangent arrival "
+                        f"but device {src} ran no backward at tick "
+                        f"{t - 1}")]
+            if tab("f_do")[t, d] == 1:
+                c = int(tab("f_chunk")[t, d]) * S + d
+                m = int(tab("f_micro")[t, d])
+                slot = int(tab("f_slot")[t, d])
+                if tab("f_from_x")[t, d] == 1:
+                    if c != 0:
+                        return defects + [(
+                            f"from-x-chunk:t{t}d{d}",
+                            f"tick {t} device {d}: reads the microbatched "
+                            f"input for chunk {c} — only chunk 0 may "
+                            f"inject from x")]
+                else:
+                    t_p = done_f.get((c - 1, m))
+                    arrive = None if t_p is None else t_p + 1
+                    ok = (arrive is not None and arrive < T
+                          and tab("rxf_do")[arrive, d] == 1
+                          and int(tab("rxf_slot")[arrive, d]) == slot
+                          and t >= arrive + (L - 1))
+                    if not ok:
+                        return defects + [(
+                            f"hop-mismatch-f:t{t}d{d}",
+                            f"tick {t} device {d}: forward of chunk {c} "
+                            f"microbatch {m} reads stash slot {slot}, but "
+                            f"no matching hop banks that value (producer "
+                            f"chunk {c - 1} ran at tick {t_p} on device "
+                            f"{(d - 1) % S}; arrival must bank slot "
+                            f"{slot} at tick {arrive} and be consumed no "
+                            f"earlier than hop latency {L} allows)")]
+                    clobber = overwritten(act_writes, d, slot, arrive, t,
+                                          own=arrive)
+                    if clobber:
+                        return defects + [(
+                            f"stash-clobber-f:t{t}d{d}",
+                            f"tick {t} device {d}: stash slot {slot} was "
+                            f"overwritten at tick {clobber[0][0]} "
+                            f"({clobber[0][2]}) between the arrival at "
+                            f"tick {arrive} and the forward that reads "
+                            f"it")]
+            if train and tab("b_do")[t, d] == 1:
+                c = int(tab("b_chunk")[t, d]) * S + d
+                m = int(tab("b_micro")[t, d])
+                t_f = done_f.get((c, m))
+                # recompute-VJP input: the backward must read the SAME
+                # stash slot its forward used, still unclobbered
+                if t_f is None or int(tab("b_slot")[t, d]) != \
+                        int(tab("f_slot")[t_f, d]):
+                    return defects + [(
+                        f"stash-mismatch-b:t{t}d{d}",
+                        f"tick {t} device {d}: backward of chunk {c} "
+                        f"microbatch {m} reads stash slot "
+                        f"{int(tab('b_slot')[t, d])} but its forward "
+                        f"(tick {t_f}) stashed into slot "
+                        f"{None if t_f is None else int(tab('f_slot')[t_f, d])}")]
+                if c == C - 1:
+                    # loss-seeded: same-tick forward is legal only in
+                    # the packed timeline (F lane runs first)
+                    limit = t if schedule.packed else t - 1
+                    if t_f > limit:
+                        return defects + [(
+                            f"loss-before-forward:t{t}d{d}",
+                            f"tick {t} device {d}: backward of the last "
+                            f"chunk runs before its forward (tick "
+                            f"{t_f})")]
+                else:
+                    t_p = done_b.get((c + 1, m))
+                    arrive = None if t_p is None else t_p + 1
+                    slot = int(tab("b_rx")[t, d])
+                    ok = (arrive is not None and arrive < T
+                          and tab("rxb_do")[arrive, d] == 1
+                          and int(tab("rxb_slot")[arrive, d]) == slot
+                          and t >= arrive + (L - 1))
+                    if not ok:
+                        return defects + [(
+                            f"hop-mismatch-b:t{t}d{d}",
+                            f"tick {t} device {d}: backward of chunk {c} "
+                            f"microbatch {m} reads cotangent slot {slot}, "
+                            f"but no matching -1 hop banks it (producer "
+                            f"chunk {c + 1} backward ran at tick {t_p} on "
+                            f"device {(d + 1) % S}, arrival tick "
+                            f"{arrive})")]
+                    clobber = overwritten(brx_writes, d, slot, arrive, t,
+                                          own=arrive)
+                    if clobber:
+                        return defects + [(
+                            f"cotangent-clobber:t{t}d{d}",
+                            f"tick {t} device {d}: cotangent slot {slot} "
+                            f"overwritten at tick {clobber[0][0]} before "
+                            f"the backward that reads it")]
+    return defects
+
+
+_START = "collective-permute-start"
+_DONE = "collective-permute-done"
+
+
+def check_start_done_pairing(text: str) -> tp.List[tp.Tuple[str, str]]:
+    """Async `-start`/`-done` pairing over an HLO module's text: every
+    start consumed by exactly one later done, no dangling dones. Sync
+    lowerings (CPU) have no pairs and pass trivially."""
+    defects: tp.List[tp.Tuple[str, str]] = []
+    open_starts: tp.Dict[str, int] = {}
+    consumed: tp.Dict[str, int] = {}
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        stripped = line.strip()
+        if f" {_START}(" in stripped or stripped.startswith(_START):
+            # the defined name is the last %token left of '=' (handles
+            # a leading `ROOT` marker; HLO names carry dots and dashes)
+            lhs = stripped.split("=", 1)[0] if "=" in stripped else ""
+            found = re.findall(r"%([\w.\-]+)", lhs)
+            if found:
+                open_starts[found[-1]] = lineno
+        elif _DONE + "(" in stripped:
+            # the operand may carry its tuple TYPE before the name
+            # (`done((f32[..], u32[]) %start.1)`), so extract %names —
+            # type tokens carry no '%' and cannot alias a start
+            after = stripped.split(_DONE + "(", 1)[1]
+            refs = re.findall(r"%([\w.\-]+)", after)
+            matched = False
+            for ref in refs:
+                if ref in open_starts:
+                    consumed[ref] = lineno
+                    del open_starts[ref]
+                    matched = True
+                    break
+                if ref in consumed:
+                    defects.append((
+                        f"double-done:{ref}",
+                        f"HLO line {lineno}: {_DONE} consumes %{ref} "
+                        f"already completed at line {consumed[ref]}"))
+                    matched = True
+                    break
+            if not matched:
+                # a done must complete a start we saw earlier; a miss
+                # means corrupt HLO OR that the start parser failed on
+                # a format variant — either way, loud
+                ref = refs[0] if refs else f"line{lineno}"
+                defects.append((
+                    f"unknown-done:{ref}",
+                    f"HLO line {lineno}: {_DONE} completes %{ref}, "
+                    f"which matches no earlier {_START} — corrupt "
+                    f"program or an unparsed start spelling"))
+    for name, lineno in sorted(open_starts.items(), key=lambda kv: kv[1]):
+        defects.append((
+            f"unmatched-start:{name}",
+            f"HLO line {lineno}: {_START} %{name} has no matching "
+            f"{_DONE} — the async hop never completes"))
+    return defects
+
+
+class CollectiveOrderAuditor(TraceAuditor):
+    code = "FT102"
+    name = "collective-order"
+    explain = ("the pipeline's ppermute sequence (ring perms, program "
+               "order, async start/done pairing) must model-check "
+               "against the schedule's tick tables: every hop matched, "
+               "no rank-divergent ordering, no stash clobbers")
+
+    def audit(self, program: AuditProgram) -> tp.Iterable[TraceFinding]:
+        schedule = program.schedule
+        if schedule is not None:
+            for key, message in model_check_schedule(schedule):
+                yield _finding(
+                    self.code, program.label, key, message,
+                    "regenerate the tables with build_1f1b_schedule — "
+                    "hand-edited or corrupted tick tables break the "
+                    "bitwise-gradient guarantee")
+        if program.jaxpr is not None and schedule is not None:
+            yield from self._audit_perms(program, schedule)
+        if program.compiled is not None:
+            for key, message in check_start_done_pairing(
+                    hlo_text(program.compiled)):
+                yield _finding(self.code, program.label, key, message)
+
+    def _audit_perms(self, program: AuditProgram, schedule: tp.Any
+                     ) -> tp.Iterable[TraceFinding]:
+        from ...parallel.schedules import ring_perms
+        S = schedule.num_stages
+        want_fwd, want_bwd = (tuple(p) for p in ring_perms(S))
+        perms = [(axes, perm)
+                 for axes, perm in extract_ppermutes(program.jaxpr)
+                 if program.axis in axes]
+        if not perms:
+            yield _finding(
+                self.code, program.label, "no-ppermute",
+                f"traced program contains no ppermute on axis "
+                f"{program.axis!r} — the pipeline ring was optimized "
+                f"away or traced without the mesh axis",
+                "trace through shard_map with the real mesh")
+            return
+        for index, (axes, perm) in enumerate(perms):
+            sources = [a for a, _ in perm]
+            dests = [b for _, b in perm]
+            if len(set(sources)) != len(sources) \
+                    or len(set(dests)) != len(dests):
+                yield _finding(
+                    self.code, program.label, f"rank-divergent:{index}",
+                    f"ppermute #{index} on {axes} is not a bijection "
+                    f"({perm}) — ranks disagree on who sends/receives "
+                    f"and the collective deadlocks or drops a lane")
+            elif perm not in (want_fwd, want_bwd):
+                yield _finding(
+                    self.code, program.label, f"off-ring:{index}",
+                    f"ppermute #{index} permutation {perm} is neither "
+                    f"the +1 activation ring nor the -1 cotangent ring "
+                    f"of a {S}-stage pipeline")
+        got = [perm for _, perm in perms]
+        if schedule.mode == "train" and want_fwd in got and want_bwd in got \
+                and got.index(want_fwd) > got.index(want_bwd):
+            yield _finding(
+                self.code, program.label, "hop-order",
+                "cotangent (-1) hop is issued before the activation "
+                "(+1) hop in program order — every rank must agree on "
+                "the tick body's collective order (forward lane first)")

@@ -1,0 +1,242 @@
+# The trace half's spine. The AST half (analysis.core) judges source
+# text; this half judges what jax actually BUILT — jaxprs and compiled
+# executables — because the invariants the perf claims ride on (opt
+# state truly 1/N per chip, ppermute hop tables deadlock-free, zero
+# post-warm-up retraces) are properties of the traced program that a
+# silent sharding-propagation fallback can violate without changing a
+# single source line. Auditors are pure functions of an AuditProgram;
+# baselining reuses the AST half's fingerprint format and gate
+# semantics ("no NEW findings"), with the program label + a stable
+# detail key standing in for (path, line text).
+"""Trace-audit core: AuditProgram, TraceFinding, auditor base, baseline."""
+from pathlib import Path
+import dataclasses
+import typing as tp
+
+import numpy as np
+
+from ..baseline import fingerprint as _source_fingerprint, load_baseline
+from ..core import Finding
+
+__all__ = [
+    "AuditProgram", "TraceAuditor", "TraceFinding", "iter_subjaxprs",
+    "jaxpr_flops", "load_trace_baseline", "new_trace_findings",
+    "run_auditors", "save_trace_baseline", "trace_fingerprint",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceFinding:
+    """One trace-level violation.
+
+    `program` plays the role the file path plays in the AST half;
+    `key` is the stable fingerprint detail (no measured numbers — a
+    byte count in the key would make every re-measure a "new" finding),
+    `message` carries the measurements.
+    """
+    code: str          # 'FT101'...
+    program: str       # audited program label, e.g. 'zero/zero1-step'
+    key: str           # stable detail, e.g. 'replicated-leaf:opt_state.mu'
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        text = f"{self.program}: {self.code} {self.message}"
+        if self.hint:
+            text += f" [hint: {self.hint}]"
+        return text
+
+
+@dataclasses.dataclass
+class AuditProgram:
+    """One audited program plus the facts the auditors consume.
+
+    Producers (the demo sweeps, tests, user code) fill in whatever they
+    have; each auditor skips programs missing its inputs:
+
+    * `compiled` — `jax.stages.Compiled` (or its `as_text()` string),
+      for sharding-layout and HLO-collective checks (FT101) and async
+      start/done pairing (FT102).
+    * `jaxpr` — a ClosedJaxpr of the traced program, for collective
+      extraction (FT102).
+    * `schedule` — a `parallel.schedules.PipelineSchedule`, model-checked
+      by FT102 and cost-audited by FT104.
+    * `expect_sharded` / `expect_replicated` — substrings of flattened
+      output tree paths whose leaves must compile sharded / replicated
+      (FT101); `state` is the live post-step state for the
+      `per_device_bytes` cross-check, `sharded_bytes_ratio` its ceiling.
+    * `require_collectives` / `forbid_collectives` — HLO expectations:
+      each require entry is an op name (or tuple of alternatives) that
+      must appear; forbid maps op -> byte floor above which it is an
+      unexpected collective.
+    * `signatures` — per-call abstract signatures (FT103), typically
+      from `recompile_risk.call_signature`; `fn`/`arg_sets` instead let
+      FT103 derive them (and deep-check scalar-shape retraces).
+    * `noqa` — auditor codes suppressed for this program (the trace
+      analogue of the source half's `# flashy: noqa[FTxxx]`).
+    """
+    label: str
+    compiled: tp.Any = None
+    jaxpr: tp.Any = None
+    schedule: tp.Any = None
+    axis: str = "pipe"
+    expect_sharded: tp.Sequence[str] = ()
+    expect_replicated: tp.Sequence[str] = ()
+    state: tp.Any = None
+    sharded_bytes_ratio: tp.Optional[float] = None
+    require_collectives: tp.Sequence[tp.Any] = ()
+    forbid_collectives: tp.Mapping[str, int] = dataclasses.field(
+        default_factory=dict)
+    signatures: tp.Optional[tp.Sequence[tp.Any]] = None
+    fn: tp.Optional[tp.Callable] = None
+    arg_sets: tp.Optional[tp.Sequence[tp.Any]] = None
+    warmup: int = 1
+    dead_compute_budget: tp.Optional[float] = None
+    noqa: tp.FrozenSet[str] = frozenset()
+
+
+class TraceAuditor:
+    """Base class mirroring `analysis.core.Checker`: subclasses set
+    `code`/`name`/`explain` and implement `audit`. Stateless — one
+    instance is reused across programs."""
+
+    code: str = "FT100"
+    name: str = "base"
+    explain: str = ""
+
+    def audit(self, program: AuditProgram) -> tp.Iterable[TraceFinding]:
+        raise NotImplementedError
+
+
+def run_auditors(programs: tp.Sequence[AuditProgram],
+                 auditors: tp.Sequence[TraceAuditor],
+                 ) -> tp.Tuple[tp.List[TraceFinding], tp.List[TraceFinding]]:
+    """(active, suppressed) findings over `programs`, sorted by
+    (program, code, key) — the trace analogue of `run_checks`."""
+    active: tp.List[TraceFinding] = []
+    suppressed: tp.List[TraceFinding] = []
+    for program in programs:
+        for auditor in auditors:
+            for finding in auditor.audit(program):
+                (suppressed if finding.code in program.noqa
+                 else active).append(finding)
+    key = lambda f: (f.program, f.code, f.key)  # noqa: E731
+    return sorted(active, key=key), sorted(suppressed, key=key)
+
+
+# ----------------------------------------------------------------------
+# baseline (same file format + gate semantics as analysis.baseline)
+# ----------------------------------------------------------------------
+DEFAULT_TRACE_BASELINE_NAME = ".analysis-trace-baseline.json"
+
+
+def trace_fingerprint(finding: TraceFinding) -> str:
+    """Same `path::code::detail` format as the source half, with the
+    program label as the path and the stable key as the detail — so one
+    `load_baseline` reads both files."""
+    shim = Finding(code=finding.code, path=finding.program, line=0, col=0,
+                   message="")
+    return _source_fingerprint(shim, finding.key)
+
+
+def load_trace_baseline(path: Path) -> tp.Dict[str, int]:
+    return load_baseline(path)
+
+
+def save_trace_baseline(path: Path,
+                        findings: tp.Sequence[TraceFinding]) -> None:
+    import collections
+    import json
+    counter: tp.Counter = collections.Counter(
+        trace_fingerprint(f) for f in findings)
+    payload = {
+        "version": 1,
+        "comment": ("flashy_tpu.analysis trace baseline — grandfathered "
+                    "FT1xx findings; the gate is 'no NEW findings'. "
+                    "Regenerate with --trace --write-baseline."),
+        "entries": dict(sorted(counter.items())),
+    }
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+
+
+def new_trace_findings(findings: tp.Sequence[TraceFinding],
+                       baseline: tp.Mapping[str, int]
+                       ) -> tp.List[TraceFinding]:
+    """Findings beyond the baselined count for their fingerprint (the
+    same budget rule as `analysis.baseline.new_findings`)."""
+    budget = dict(baseline)
+    fresh: tp.List[TraceFinding] = []
+    for finding in findings:
+        key = trace_fingerprint(finding)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+        else:
+            fresh.append(finding)
+    return fresh
+
+
+# ----------------------------------------------------------------------
+# shared jaxpr helpers
+# ----------------------------------------------------------------------
+def iter_subjaxprs(jaxpr: tp.Any) -> tp.Iterator[tp.Any]:
+    """The jaxprs directly embedded in an eqn-carrying jaxpr's params
+    (scan/cond/pjit/shard_map/custom-vjp bodies), unwrapped to the
+    plain `Jaxpr` so callers can recurse on `.eqns`."""
+    for eqn in jaxpr.eqns:
+        for value in eqn.params.values():
+            values = value if isinstance(value, (list, tuple)) else [value]
+            for sub in values:
+                if hasattr(sub, "eqns"):
+                    yield sub
+                elif hasattr(sub, "jaxpr") and hasattr(sub.jaxpr, "eqns"):
+                    yield sub.jaxpr
+
+
+def _dot_general_flops(eqn: tp.Any) -> float:
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    b = float(np.prod([lhs[i] for i in lb])) if lb else 1.0
+    k = float(np.prod([lhs[i] for i in lc])) if lc else 1.0
+    m = float(np.prod([d for i, d in enumerate(lhs)
+                       if i not in lb and i not in lc]))
+    n = float(np.prod([d for i, d in enumerate(rhs)
+                       if i not in rb and i not in rc]))
+    return 2.0 * b * m * n * k
+
+
+def jaxpr_flops(jaxpr: tp.Any) -> float:
+    """Matmul FLOPs of a (closed) jaxpr: 2·B·M·N·K per `dot_general`,
+    scan bodies multiplied by their length, cond counted at the most
+    expensive branch (the loss-leg convention: a cond's lanes all pay
+    for the executable's worst case under SPMD masking). Elementwise
+    ops are ignored — on the MXU-bound programs this audits, matmuls
+    ARE the cost model."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)  # accept ClosedJaxpr
+    total = 0.0
+    for eqn in inner.eqns:
+        if eqn.primitive.name == "dot_general":
+            total += _dot_general_flops(eqn)
+            continue
+        subs = []
+        for value in eqn.params.values():
+            values = value if isinstance(value, (list, tuple)) else [value]
+            for sub in values:
+                if hasattr(sub, "eqns") or (hasattr(sub, "jaxpr")
+                                            and hasattr(sub.jaxpr, "eqns")):
+                    subs.append(sub)
+        if not subs:
+            continue
+        if eqn.primitive.name == "cond":
+            total += max(jaxpr_flops(sub) for sub in subs)
+        elif eqn.primitive.name in ("scan", "while"):
+            mult = float(eqn.params.get("length", 1) or 1)
+            total += mult * sum(jaxpr_flops(sub) for sub in subs)
+        else:
+            total += sum(jaxpr_flops(sub) for sub in subs)
+    return total
+
+
+def hlo_text(compiled: tp.Any) -> str:
+    """`compiled` as HLO text (pass-through for strings)."""
+    return compiled if isinstance(compiled, str) else compiled.as_text()

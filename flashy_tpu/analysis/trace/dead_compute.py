@@ -1,0 +1,117 @@
+# FT104 — masked-lane dead compute. The SPMD pipeline body executes
+# BOTH lanes (one forward, one backward) on every device every tick and
+# zero-masks the idle ones, so the schedule-theoretic bubble is not an
+# abstraction: it is real FLOPs burned on zeros. Packing exists
+# precisely to narrow that waste (vM useful F+B pairs over
+# vM+(v+1)S-2 ticks instead of 2(vM+S-1)); ROADMAP item 3's MPMD
+# direction exists to remove it entirely. This auditor prices a
+# schedule's idle lanes in FLOPs (lane costs measured from the traced
+# jaxpr when available, the 1:2 forward:backward matmul convention
+# otherwise), compares the audited tables against the canonical
+# generator's theoretical cost at the same (S, M, v), and trips when
+# the realized dead fraction regresses past it — so an accidentally
+# degraded tick table (extra fill ticks, lost co-scheduling) fails CI
+# instead of silently re-widening the gap the packed PR closed.
+"""FT104 masked-lane dead-compute: FLOP-priced idle-lane accounting."""
+import typing as tp
+
+import numpy as np
+
+from .core import AuditProgram, TraceAuditor, TraceFinding
+
+__all__ = ["DeadComputeAuditor", "dead_compute_stats"]
+
+# Matmul-FLOP lane weights when no jaxpr is provided: the backward lane
+# recomputes the stage forward and runs its VJP (two matmul-shaped
+# products per forward matmul) — 1:2 is the standard estimate.
+DEFAULT_LANE_COSTS = (1.0, 2.0)
+
+
+def dead_compute_stats(schedule: tp.Any,
+                       lane_costs: tp.Tuple[float, float] = DEFAULT_LANE_COSTS
+                       ) -> tp.Dict[str, float]:
+    """FLOP-weighted lane accounting of one `PipelineSchedule`.
+
+    The train-mode SPMD body pays `f_cost + b_cost` on every device
+    every tick regardless of the tables (masked lanes compute on
+    zeros); forward-only bodies pay `f_cost`. Returns:
+
+    * ``paid_flops`` — relative total the executable burns,
+    * ``useful_flops`` — the part the tables route to real work,
+    * ``dead_frac`` — 1 - useful/paid, the masked-lane waste,
+    * ``useful_frac`` — the complement, and
+    * ``per_tick_cost`` — the lane-cost sum (scales step-time models).
+    """
+    f_cost, b_cost = (float(c) for c in lane_costs)
+    S, T = schedule.num_stages, schedule.num_ticks
+    train = schedule.mode == "train"
+    f_busy = float(np.asarray(schedule.tables["f_do"]).sum())
+    b_busy = float(np.asarray(schedule.tables["b_do"]).sum()) if train \
+        else 0.0
+    per_tick = f_cost + (b_cost if train else 0.0)
+    paid = S * T * per_tick
+    useful = f_busy * f_cost + b_busy * b_cost
+    return {
+        "paid_flops": paid,
+        "useful_flops": useful,
+        "dead_frac": 1.0 - useful / paid if paid else 0.0,
+        "useful_frac": useful / paid if paid else 1.0,
+        "per_tick_cost": per_tick,
+    }
+
+
+class DeadComputeAuditor(TraceAuditor):
+    code = "FT104"
+    name = "dead-compute"
+    explain = ("a schedule's FLOP-weighted idle-lane fraction must not "
+               "exceed the canonical generator's at the same "
+               "(S, M, v, packed) — the regression tripwire for the "
+               "masked-SPMD waste the packed schedule narrowed")
+
+    # absolute slack on the dead fraction before a regression trips
+    tolerance = 1e-6
+
+    def audit(self, program: AuditProgram) -> tp.Iterable[TraceFinding]:
+        schedule = program.schedule
+        if schedule is None:
+            return
+        # dead_frac is a cost RATIO, so only the forward:backward split
+        # matters — the 1:2 convention prices both sides identically
+        costs = DEFAULT_LANE_COSTS
+        realized = dead_compute_stats(schedule, costs)
+        theoretical = self._theoretical(schedule, costs)
+        budget = (program.dead_compute_budget
+                  if program.dead_compute_budget is not None
+                  else (theoretical["dead_frac"] + self.tolerance
+                        if theoretical is not None else None))
+        if budget is None:
+            return
+        if realized["dead_frac"] > budget:
+            base = (f"canonical schedule at the same (S, M, v) wastes "
+                    f"{theoretical['dead_frac']:.4f}"
+                    if theoretical is not None
+                    else f"budget {budget:.4f}")
+            yield TraceFinding(
+                self.code, program.label, "dead-compute-regression",
+                f"schedule burns {realized['dead_frac']:.4f} of its paid "
+                f"lane-FLOPs on masked idle lanes "
+                f"({realized['useful_flops']:.0f} useful of "
+                f"{realized['paid_flops']:.0f}); {base} — the tick table "
+                f"regressed (extra fill ticks or lost co-scheduling)",
+                "rebuild via build_1f1b_schedule; if the regression is "
+                "intentional (new schedule family), re-baseline with "
+                "--trace --write-baseline")
+
+    @staticmethod
+    def _theoretical(schedule: tp.Any, costs: tp.Tuple[float, float]
+                     ) -> tp.Optional[tp.Dict[str, float]]:
+        from ...parallel.schedules import build_1f1b_schedule
+        try:
+            canonical = build_1f1b_schedule(
+                schedule.num_stages, schedule.num_micro,
+                schedule.interleave, schedule.mode,
+                packed=schedule.packed,
+                overlap=schedule.hop_latency > 1)
+        except ValueError:
+            return None  # non-canonical shape: caller must pass a budget
+        return dead_compute_stats(canonical, costs)

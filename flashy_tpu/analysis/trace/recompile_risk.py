@@ -1,0 +1,149 @@
+# FT103 — recompile risk, before the flight. The runtime
+# RecompileWatchdog counts retraces AFTER they cost a multi-second
+# stall; but the jit cache key is a pure function of the call's
+# abstract signature (treedef + per-leaf shape/dtype/weak-type), so
+# "will these representative calls retrace" is decidable without
+# running anything. This auditor abstracts each representative arg set
+# the way jit would, counts distinct signatures against the warm-up
+# budget, names the exact leaf (and which component — shape, dtype, or
+# a weak-type flip from mixing Python scalars with typed arrays) that
+# splits them, and abstract-traces the callable once to catch scalars
+# flowing into shapes (`jnp.zeros((n,))` on an argument) — the
+# retrace-per-value class no signature comparison can see.
+"""FT103 recompile-risk: pre-flight jit-cache-signature analysis."""
+import typing as tp
+
+from .core import AuditProgram, TraceAuditor, TraceFinding
+
+__all__ = ["RecompileRiskAuditor", "call_signature"]
+
+_SCALARS = (bool, int, float, complex)
+
+
+def _leaf_sig(leaf: tp.Any) -> tp.Tuple[tp.Any, ...]:
+    if isinstance(leaf, _SCALARS):
+        # jit traces a Python scalar as a weak-typed 0-d array
+        return ((), f"py-{type(leaf).__name__}", True)
+    shape = tuple(getattr(leaf, "shape", ()))
+    dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+    weak = bool(getattr(leaf, "weak_type", False))
+    return (shape, dtype, weak)
+
+
+def call_signature(args: tp.Any, kwargs: tp.Any = None
+                   ) -> tp.Tuple[tp.Any, ...]:
+    """The jit-cache-key stand-in for one call: (treedef repr, per-leaf
+    (shape, dtype, weak_type)). Two calls with equal signatures share a
+    compiled executable; unequal signatures are a retrace."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs or {}))
+    return (repr(treedef),) + tuple(_leaf_sig(leaf) for leaf in leaves)
+
+
+def _diff_leaves(a: tp.Tuple, b: tp.Tuple) -> tp.List[tp.Tuple[int, str]]:
+    """(leaf index, differing component) between two signatures."""
+    out = []
+    if a[0] != b[0]:
+        out.append((-1, "tree structure"))
+    for index, (la, lb) in enumerate(zip(a[1:], b[1:])):
+        if la == lb:
+            continue
+        if la[0] != lb[0]:
+            out.append((index, f"shape {la[0]} vs {lb[0]}"))
+        elif la[1] != lb[1]:
+            out.append((index, f"dtype {la[1]} vs {lb[1]}"))
+        elif la[2] != lb[2]:
+            out.append((index, "weak-type flip (Python scalar on one "
+                               "side, typed array on the other)"))
+    return out
+
+
+class RecompileRiskAuditor(TraceAuditor):
+    code = "FT103"
+    name = "recompile-risk"
+    explain = ("representative call signatures must collapse onto at "
+               "most `warmup` jit cache entries; Python scalars must "
+               "not flow into traced shapes (retrace per value)")
+
+    def audit(self, program: AuditProgram) -> tp.Iterable[TraceFinding]:
+        signatures = list(program.signatures or ())
+        if program.fn is not None and program.arg_sets:
+            signatures += [call_signature(args, kwargs)
+                           for args, kwargs in _norm(program.arg_sets)]
+            yield from self._audit_scalar_shapes(program)
+        if signatures:
+            yield from self._audit_signatures(program, signatures)
+
+    def _audit_signatures(self, program: AuditProgram,
+                          signatures: tp.Sequence[tp.Tuple]
+                          ) -> tp.Iterable[TraceFinding]:
+        distinct: tp.List[tp.Tuple] = []
+        for sig in signatures:
+            if sig not in distinct:
+                distinct.append(sig)
+        if len(distinct) <= program.warmup:
+            return
+        # blame the components that split the FIRST signature from each
+        # extra one — that is the retrace the watchdog would WARN about
+        for extra_index, sig in enumerate(distinct[program.warmup:]):
+            diffs = _diff_leaves(distinct[0], sig)
+            detail = "; ".join(f"leaf {i}: {why}" for i, why in diffs[:4]) \
+                or "argument count changed"
+            yield TraceFinding(
+                self.code, program.label,
+                f"retrace:{program.warmup + extra_index}",
+                f"{len(distinct)} distinct call signatures over "
+                f"{len(signatures)} representative calls (warm-up budget "
+                f"{program.warmup}) — signature "
+                f"#{program.warmup + extra_index + 1} differs from #0 in "
+                f"{detail}",
+                "pad/bucket the offending argument to a fixed shape, or "
+                "jnp.asarray scalars with an explicit dtype so the "
+                "weak-type cannot flip")
+
+    def _audit_scalar_shapes(self, program: AuditProgram
+                             ) -> tp.Iterable[TraceFinding]:
+        import jax
+        args, kwargs = _norm(program.arg_sets)[0]
+        scalar_leaves = [leaf for leaf in
+                         jax.tree_util.tree_leaves((args, kwargs))
+                         if isinstance(leaf, _SCALARS)]
+        if not scalar_leaves:
+            return
+        try:
+            jax.eval_shape(program.fn, *args, **kwargs)
+        except Exception as exc:  # noqa: BLE001 — any trace abort counts
+            text = f"{type(exc).__name__}: {exc}"
+            if "concrete" in text.lower() or "Shapes must be" in text:
+                yield TraceFinding(
+                    self.code, program.label, "scalar-shape",
+                    f"abstract tracing aborts when the Python-scalar "
+                    f"argument is treated as traced — a scalar flows "
+                    f"into a shape, so under jit this either errors or "
+                    f"(with static_argnums) recompiles per VALUE: "
+                    f"{text.splitlines()[0][:160]}",
+                    "make the scalar a static_argnum ONLY if its value "
+                    "set is tiny and fixed; otherwise restructure so "
+                    "shapes come from configuration, not data")
+            else:
+                yield TraceFinding(
+                    self.code, program.label, "trace-abort",
+                    f"abstract tracing of the audited callable failed: "
+                    f"{text.splitlines()[0][:160]}",
+                    "the program cannot be audited as called; fix the "
+                    "arg sets or the callable")
+
+
+def _norm(arg_sets: tp.Sequence[tp.Any]
+          ) -> tp.List[tp.Tuple[tp.Tuple, tp.Dict]]:
+    """Normalize arg sets to (args tuple, kwargs dict) pairs: a bare
+    tuple is positional-only."""
+    out = []
+    for entry in arg_sets:
+        if (isinstance(entry, tuple) and len(entry) == 2
+                and isinstance(entry[0], tuple)
+                and isinstance(entry[1], dict)):
+            out.append((entry[0], entry[1]))
+        else:
+            out.append((tuple(entry), {}))
+    return out

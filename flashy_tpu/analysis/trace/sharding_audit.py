@@ -1,0 +1,165 @@
+# FT101 — the sharding audit. `zero_sharding`/`fsdp_sharding` DECLARE
+# a layout; whether the compiled program honors it is the partitioner's
+# call, and its fallback is silent: a spec that fails to propagate
+# compiles to full replication, the program stays numerically correct,
+# and the "opt state is 1/N per chip" claim quietly becomes false
+# (arXiv 2004.13336's accidental-full-replication failure mode). The
+# compiled executable cannot lie: its output shardings, its collective
+# mix, and the live arrays' per-device shard bytes all record what XLA
+# actually built. This auditor checks all three against the declared
+# expectations. The HLO-op check is configurable per program because
+# backends lower the same promise differently — CPU spells the zero1
+# grad reduction all-reduce + slice where TPU emits a literal
+# reduce-scatter — so "promises reduce-scatter" audits as "the grad
+# reduction exists AND nothing all-gathers the opt state", not as a
+# grep for one op name.
+"""FT101 sharding-audit: declared-sharded leaves vs compiled layouts."""
+import typing as tp
+
+from .core import AuditProgram, TraceAuditor, TraceFinding, hlo_text
+
+__all__ = ["ShardingAuditor", "flat_shardings", "leaf_path_strings"]
+
+
+def leaf_path_strings(tree: tp.Any) -> tp.List[tp.Tuple[str, tp.Any]]:
+    """Flatten a pytree to `(dotted-ish path string, leaf)` pairs using
+    jax's keystr (e.g. `[0]['opt_state'].mu['w1']`)."""
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def flat_shardings(compiled: tp.Any) -> tp.List[tp.Tuple[str, tp.Any]]:
+    """(path, sharding) pairs of a compiled executable's outputs."""
+    return leaf_path_strings(compiled.output_shardings)
+
+
+def _is_replicated(sharding: tp.Any) -> tp.Optional[bool]:
+    flag = getattr(sharding, "is_fully_replicated", None)
+    if flag is None:
+        return None
+    return bool(flag)
+
+
+def _matches(path: str, needles: tp.Sequence[str]) -> bool:
+    return any(needle in path for needle in needles)
+
+
+def _full_bytes(tree: tp.Any) -> int:
+    import numpy as np
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape)) * np.dtype(dtype).itemsize
+    return total
+
+
+class ShardingAuditor(TraceAuditor):
+    code = "FT101"
+    name = "sharding-audit"
+    explain = ("declared-sharded leaves must compile to sharded layouts "
+               "(no silent replication fallback), the HLO collective mix "
+               "must match the program's promise, and live per-device "
+               "bytes must show the ~1/N shard")
+
+    def audit(self, program: AuditProgram) -> tp.Iterable[TraceFinding]:
+        if program.compiled is not None and not isinstance(program.compiled,
+                                                           str):
+            yield from self._audit_layouts(program)
+        if program.compiled is not None and (program.require_collectives
+                                             or program.forbid_collectives):
+            yield from self._audit_collectives(program)
+        if program.state is not None and program.expect_sharded:
+            yield from self._audit_live_bytes(program)
+
+    def _audit_layouts(self, program: AuditProgram
+                       ) -> tp.Iterable[TraceFinding]:
+        for path, sharding in flat_shardings(program.compiled):
+            replicated = _is_replicated(sharding)
+            if replicated is None:
+                continue  # backend without layout introspection
+            if _matches(path, program.expect_sharded) and replicated:
+                yield TraceFinding(
+                    self.code, program.label,
+                    f"replicated-leaf:{path}",
+                    f"output leaf {path} was declared sharded but "
+                    f"compiled to a fully-replicated layout — the "
+                    f"partitioner silently fell back to replication",
+                    "check the sharding spec reaches the jit boundary "
+                    "(with_sharding_constraint / state_sharding) and that "
+                    "the leaf's dims are divisible by the mesh axis")
+            elif _matches(path, program.expect_replicated) \
+                    and replicated is False:
+                yield TraceFinding(
+                    self.code, program.label,
+                    f"sharded-leaf:{path}",
+                    f"output leaf {path} was declared replicated but "
+                    f"compiled sharded ({getattr(sharding, 'spec', '?')}) "
+                    f"— every consumer now pays an implicit all-gather",
+                    "drop the stray spec or re-gather explicitly where "
+                    "the layout is intended")
+
+    def _audit_collectives(self, program: AuditProgram
+                           ) -> tp.Iterable[TraceFinding]:
+        from ...parallel.accounting import collective_stats
+        stats = collective_stats(hlo_text(program.compiled))
+        for entry in program.require_collectives:
+            ops = entry if isinstance(entry, (tuple, list)) else (entry,)
+            if not any(stats.get(op, {}).get("count", 0) for op in ops):
+                label = " or ".join(ops)
+                yield TraceFinding(
+                    self.code, program.label,
+                    f"missing-collective:{'|'.join(ops)}",
+                    f"compiled program contains no {label} — the promised "
+                    f"communication pattern did not lower (a sharding "
+                    f"that regressed to replication stops communicating)",
+                    "diff collective_stats against a known-good compile "
+                    "(compare_collective_stats)")
+        for op, floor in program.forbid_collectives.items():
+            entry = stats.get(op, {"count": 0, "bytes": 0})
+            if entry["bytes"] > floor:
+                yield TraceFinding(
+                    self.code, program.label,
+                    f"unexpected-collective:{op}",
+                    f"compiled program moves {entry['bytes']} bytes of "
+                    f"{op} (> the {floor}-byte budget) — in a program "
+                    f"promising sharded updates this is the opt-state / "
+                    f"gradient being gathered or reduced at full size",
+                    "the reduce-scatter promise broke; inspect the HLO "
+                    "around the update and re-pin the shard specs")
+
+    def _audit_live_bytes(self, program: AuditProgram
+                          ) -> tp.Iterable[TraceFinding]:
+        from ...parallel.zero import per_device_bytes
+        import jax
+        n = max(len(jax.devices()), 1)
+        # capped below 1.0: full replication (ratio 1.0) must trip the
+        # check at EVERY device count, including n=2 where the slack
+        # formula alone would reach exactly 1.0
+        ceiling = (program.sharded_bytes_ratio
+                   if program.sharded_bytes_ratio is not None
+                   else min(1.5 / n + 0.25, 0.75))
+        sub = {path: leaf for path, leaf in leaf_path_strings(program.state)
+               if _matches(path, program.expect_sharded)}
+        if not sub:
+            yield TraceFinding(
+                self.code, program.label, "no-audited-leaves",
+                f"none of the live state paths match the declared "
+                f"expect_sharded patterns {list(program.expect_sharded)} — "
+                f"the audit is vacuous", "fix the path patterns")
+            return
+        per_chip = per_device_bytes(list(sub.values()))
+        full = _full_bytes(list(sub.values()))
+        if full and per_chip / full > ceiling:
+            yield TraceFinding(
+                self.code, program.label, "per-device-bytes",
+                f"declared-sharded state holds {per_chip} bytes per chip "
+                f"of {full} total ({per_chip / full:.2f}x, ceiling "
+                f"{ceiling:.2f}) — the 1/N HBM claim does not hold on "
+                f"the live arrays",
+                "the arrays were placed replicated; device_put onto the "
+                "declared shardings (or fix zero_sharding's min_size)")

@@ -1,0 +1,235 @@
+# The live sweep `python -m flashy_tpu.analysis --trace` / `make
+# analyze-trace` runs: build shrunken-but-faithful versions of the
+# three demo programs whose perf claims the auditors gate (the zero1
+# sharded update, the 1F1B/packed pipeline, the serving engine), on
+# the current backend (CI: 8 virtual CPU devices), and hand them to
+# every FT1xx auditor. The programs are small on purpose — the
+# properties audited (compiled layouts, collective order, signature
+# stability, lane accounting) are shape-class facts, not scale facts,
+# so a 64-dim MLP proves the same invariant a 70B run relies on.
+"""Demo-program sweep for the trace auditors (FT101-FT104)."""
+import typing as tp
+
+from .core import AuditProgram
+
+__all__ = ["demo_programs", "SWEEP_LEGS"]
+
+SWEEP_LEGS = ("zero", "pipeline", "serve")
+
+
+def _require_devices(minimum: int) -> None:
+    import jax
+    n = len(jax.devices())
+    if n < minimum:
+        raise RuntimeError(
+            f"the trace sweep audits multi-device programs and found "
+            f"only {n} device(s); run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu "
+            f"(what `make analyze-trace` does)")
+
+
+def _zero_programs() -> tp.List[AuditProgram]:
+    """The zero1 + fsdp sharded-update steps: compiled layouts, the
+    collective mix, live per-device bytes, and signature stability."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ...parallel.data_parallel import fsdp_sharding
+    from ...parallel.mesh import make_mesh
+    from .recompile_risk import call_signature
+
+    _require_devices(2)
+    n = len(jax.devices())
+    dim, out, batch = 64, 8, 2 * n
+    mesh = make_mesh({"data": n})
+    key = jax.random.PRNGKey(0)
+    params = {"w1": jax.random.normal(key, (dim, dim), jnp.float32),
+              "w2": jax.random.normal(key, (dim, out), jnp.float32)}
+    param_bytes = sum(int(np.prod(p.shape)) * 4 for p in params.values())
+    optim = optax.adamw(1e-3)
+
+    def loss_fn(p: tp.Any, batch_xy: tp.Any) -> jnp.ndarray:
+        x, y = batch_xy
+        h = jnp.tanh(x @ p["w1"]) @ p["w2"]
+        return jnp.mean((h - y) ** 2)
+
+    rng = np.random.default_rng(0)
+    data_sharding = NamedSharding(mesh, P("data"))
+    batches = [
+        (jax.device_put(rng.standard_normal((batch, dim), np.float32),
+                        data_sharding),
+         jax.device_put(rng.standard_normal((batch, out), np.float32),
+                        data_sharding))
+        for _ in range(2)]
+
+    programs: tp.List[AuditProgram] = []
+
+    # --- zero1: explicit reduce-scatter / shard-update / all-gather ---
+    from ...parallel.zero import (audit_expectations, zero_sharding,
+                                  zero_update)
+    state = {"params": params, "opt_state": optim.init(params)}
+    spec = zero_sharding(state, mesh, min_size=dim)
+    state = jax.device_put(state, spec)
+    step = zero_update(jax.value_and_grad(loss_fn), optim, mesh=mesh,
+                       min_size=dim)
+    jitted = jax.jit(step)
+    compiled = jitted.lower(state, batches[0]).compile()
+    state1, _ = jitted(state, batches[0])
+    programs.append(AuditProgram(
+        label="zero/zero1-step",
+        compiled=compiled,
+        state=state1,
+        # the contract comes from the DECLARED spec itself: exactly the
+        # leaves zero_sharding shards (adam moments; the scalar `count`
+        # and the compute params stay replicated via min_size) must
+        # compile — and live — sharded
+        **audit_expectations(spec, params_bytes=param_bytes),
+        fn=step,
+        arg_sets=[(state, batches[0]), (state1, batches[1])],
+    ))
+
+    # --- fsdp: the params THEMSELVES must compile sharded ------------
+    fsdp_mesh = make_mesh({"fsdp": n})
+    fstate = {"params": jax.device_put(
+        params, fsdp_sharding(params, fsdp_mesh, min_size=dim))}
+
+    def fsdp_step(state_in: tp.Any, batch_xy: tp.Any) -> tp.Any:
+        loss, grads = jax.value_and_grad(loss_fn)(state_in["params"],
+                                                  batch_xy)
+        new = jax.tree_util.tree_map(lambda p, g: p - 1e-3 * g,
+                                     state_in["params"], grads)
+        return {"params": new}, {"loss": loss}
+
+    fbatch = (jax.device_put(np.asarray(batches[0][0]),
+                             NamedSharding(fsdp_mesh, P("fsdp"))),
+              jax.device_put(np.asarray(batches[0][1]),
+                             NamedSharding(fsdp_mesh, P("fsdp"))))
+    fjit = jax.jit(fsdp_step)
+    fcompiled = fjit.lower(fstate, fbatch).compile()
+    fstate1, _ = fjit(fstate, fbatch)
+    programs.append(AuditProgram(
+        label="zero/fsdp-step",
+        compiled=fcompiled,
+        expect_sharded=("['params']",),
+        state=fstate1,
+        # sharded params force cross-device traffic per use: a literal
+        # all-gather of the weights, or (contraction-dim shards) an
+        # all-reduce of matmul partials — absence means replication
+        require_collectives=(("all-gather", "all-reduce"),),
+        signatures=[call_signature((fstate, fbatch)),
+                    call_signature((fstate1, fbatch))],
+    ))
+    return programs
+
+
+def _pipeline_programs() -> tp.List[AuditProgram]:
+    """The 1F1B and packed-1F1B pipeline programs: tick tables
+    model-checked against the traced ppermute ring, HLO start/done
+    pairing, dead-compute accounting, signature stability."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ...parallel.mesh import make_mesh
+    from ...parallel.pipeline import pipeline_1f1b
+    from ...parallel.schedules import build_1f1b_schedule
+
+    _require_devices(2)
+    n = len(jax.devices())
+    pipe = 4 if n % 4 == 0 else 2
+    mesh = make_mesh({"pipe": pipe, "data": -1})
+    S, M, dim, batch = pipe, 2 * pipe, 8, 2 * pipe
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (S, dim, dim),
+                                     jnp.float32)}
+
+    def stage_fn(p: tp.Any, x: tp.Any) -> tp.Any:
+        return jnp.tanh(x @ p["w"])
+
+    def loss_fn(lp: tp.Any, h: tp.Any, tgt: tp.Any) -> tp.Any:
+        del lp
+        return jnp.mean((h - tgt) ** 2)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, dim)), jnp.float32)
+    x2 = jnp.asarray(rng.standard_normal((batch, dim)), jnp.float32)
+    tgt = jnp.zeros((batch, dim), jnp.float32)
+
+    programs: tp.List[AuditProgram] = []
+    for packed in (False, True):
+        def fn(p: tp.Any, xx: tp.Any, tg: tp.Any,
+               packed: bool = packed) -> tp.Any:
+            return pipeline_1f1b(stage_fn, p, xx, loss_fn=loss_fn,
+                                 loss_params={}, targets=tg, mesh=mesh,
+                                 num_microbatches=M, packed=packed,
+                                 overlap=False)
+
+        schedule = build_1f1b_schedule(S, M, 1, "train", packed=packed,
+                                       overlap=False)
+        jaxpr = jax.make_jaxpr(fn)(params, x, tgt)
+        compiled = None
+        if packed:
+            # one real compile for the async start/done pairing check
+            compiled = jax.jit(fn).lower(params, x, tgt).compile()
+        programs.append(AuditProgram(
+            label=f"pipeline/{'packed_1f1b' if packed else '1f1b'}",
+            jaxpr=jaxpr,
+            compiled=compiled,
+            schedule=schedule,
+            axis="pipe",
+            fn=fn,
+            arg_sets=[(params, x, tgt), (params, x2, tgt)],
+        ))
+    return programs
+
+
+def _serve_programs() -> tp.List[AuditProgram]:
+    """The serving engine's executable registry: every compiled
+    executable's recorded call signatures over a warmed, driven run
+    must collapse onto one jit cache entry each."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ...models import TransformerConfig, TransformerLM
+    from ...serve import DecodeEngine
+
+    cfg = TransformerConfig(vocab_size=32, dim=16, num_layers=2,
+                            num_heads=2, attention="dense",
+                            max_seq_len=32, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32))
+    engine = DecodeEngine(model, params, slots=2)
+    engine.warmup(prompt_lengths=[4])
+    slot = engine.acquire_slot()
+    engine.admit(slot, np.arange(4, dtype=np.int32) % 32, max_new_tokens=4)
+    for _ in range(3):
+        engine.decode()
+    programs = []
+    for name in sorted(engine.executables()):
+        recorded = engine.compile_cache.signatures.get(name, {})
+        if not recorded:
+            continue
+        programs.append(AuditProgram(
+            label=f"serve/{name}",
+            signatures=list(recorded),
+            warmup=1,
+        ))
+    return programs
+
+
+def demo_programs(legs: tp.Sequence[str] = SWEEP_LEGS
+                  ) -> tp.List[AuditProgram]:
+    """Build the audit programs for the requested demo legs."""
+    builders = {"zero": _zero_programs, "pipeline": _pipeline_programs,
+                "serve": _serve_programs}
+    unknown = [leg for leg in legs if leg not in builders]
+    if unknown:
+        raise ValueError(f"unknown sweep leg(s) {unknown}; "
+                         f"pick from {list(builders)}")
+    programs: tp.List[AuditProgram] = []
+    for leg in legs:
+        programs.extend(builders[leg]())
+    return programs

@@ -40,13 +40,26 @@ def describe_abstract(args: tp.Any, kwargs: tp.Any) -> str:
 
 
 class RecompileWatchdog:
-    """Wraps jitted functions and watches their compile-cache growth.
+    """Counts compiles per jitted function and flags recompiles.
 
-    `warmup` compiles per function are expected (the first trace; one
-    more for a train/eval shape pair fits `warmup=2`). Any compile past
-    that logs a WARNING with the function name and the offending
-    argument shapes, fires a tracer instant event, and is tallied in
-    `counts` so tests and the stage summary can assert on it.
+    Two report paths feed one accounting:
+
+    * `watch(jitted_fn)` wraps a `jax.jit` callable and polls its
+      compile-cache size around every call (the original PR 1 path).
+    * `note_compile(name, description)` / `note_call(name)` are the
+      DIRECT-REPORT API (PR 4) for compile caches the watchdog cannot
+      wrap — `parallel.wrap`'s per-state-shape executable cache and the
+      serving `CompileCache` report every build through it, so "zero
+      post-warm-up recompiles" is one asserted number across training
+      and serving.
+
+    `warmup` compiles per name are expected (the first trace; one more
+    for a train/eval shape pair fits `warmup=2`). Any compile past that
+    logs a WARNING with the offending argument shapes, fires a tracer
+    instant + journal record, and is tallied in `counts`. Callers read
+    the tallies via `summary()` (recompiles past warm-up per name,
+    nonzero only) or, for a `parallel.wrap`-wrapped step, via the
+    step's `wrapped.compile_stats()` ({calls, compiles, recompiles}).
     """
 
     def __init__(self, warmup: int = 1, tracer: tp.Optional[Tracer] = None,

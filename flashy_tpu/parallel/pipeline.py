@@ -32,7 +32,8 @@ from ..resilience import chaos
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .schedules import (PACKED_FORWARD_ERROR, PipelineSchedule,
-                        build_1f1b_schedule, validate_pipeline_args)
+                        build_1f1b_schedule, ring_perms,
+                        validate_pipeline_args)
 
 
 def _stage_body(stage_fn, params, x_micro, axis, num_stages, num_micro,
@@ -45,7 +46,7 @@ def _stage_body(stage_fn, params, x_micro, axis, num_stages, num_micro,
     auxiliary scalar over its valid (stage, microbatch) ticks.
     """
     stage = jax.lax.axis_index(axis)
-    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    perm, _ = ring_perms(num_stages)
     ticks = num_micro + num_stages - 1
 
     # The input is replicated over the pipe axis but everything computed
@@ -206,7 +207,8 @@ def pipeline_1f1b(stage_fn: tp.Callable, stage_params: tp.Any, x: jax.Array,
                   num_microbatches: tp.Optional[int] = None,
                   interleave: int = 1, has_aux: bool = False,
                   aux_weight: float = 0.0, packed: bool = False,
-                  overlap: tp.Optional[bool] = None):
+                  overlap: tp.Optional[bool] = None,
+                  _schedule: tp.Optional[PipelineSchedule] = None):
     """Run a stage function under the 1F1B (PipeDream-flush) schedule.
 
     The schedule is an explicit per-tick program (one `lax.scan` over
@@ -305,8 +307,23 @@ def pipeline_1f1b(stage_fn: tp.Callable, stage_params: tp.Any, x: jax.Array,
                            require_fill=(mode == "train"),
                            schedule="packed_1f1b" if packed else "1f1b",
                            mode=mode)
-    schedule = build_1f1b_schedule(num_stages, num_micro, interleave, mode,
-                                   packed=packed, overlap=overlap)
+    if _schedule is not None:
+        # Audit hook (tests + flashy_tpu.analysis.trace): drive the
+        # jitted body with an EXPLICIT schedule — e.g. a deliberately
+        # corrupted tick table — so the FT102 model check's verdict can
+        # be cross-examined against the bitwise gradient gate on the
+        # same executable. Shape facts must match; the tables need not.
+        if (_schedule.num_stages, _schedule.num_micro, _schedule.interleave,
+                _schedule.mode) != (num_stages, num_micro, interleave, mode):
+            raise ValueError(
+                f"_schedule override is for (S={num_stages}, M={num_micro}, "
+                f"v={interleave}, mode={mode!r}); got (S="
+                f"{_schedule.num_stages}, M={_schedule.num_micro}, "
+                f"v={_schedule.interleave}, mode={_schedule.mode!r})")
+        schedule = _schedule
+    else:
+        schedule = build_1f1b_schedule(num_stages, num_micro, interleave,
+                                       mode, packed=packed, overlap=overlap)
     # Deterministic host-side fault site: one tick per schedule launch
     # (trace time under jit; every call when driven eagerly). A fault
     # here surfaces as a clean typed failure before any device program
@@ -445,8 +462,7 @@ def _1f1b_device_body(local_params, x_micro, loss_params, targets_micro,
     # forward-mode path below may therefore assume early banking
     assert not (bank_late and not train), \
         "overlap (hop latency 2) schedules are train-only"
-    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
-    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+    perm_fwd, perm_bwd = ring_perms(S)
     f32 = jnp.float32
 
     def pcast_tree(tree):
@@ -754,10 +770,20 @@ def _pipeline_leg(*, moe: bool, mesh, pipe: int, steps: int, num_micro: int,
                 leg["schedules"][name] = stats
                 continue
         else:
+            overlap = default_overlap(packed, spec["interleave"], mesh)
             stats = schedule_stats(
                 pipe, num_micro, spec["interleave"], packed=packed,
-                overlap=default_overlap(packed, spec["interleave"], mesh),
-                microbatch_shape=mb_shape)
+                overlap=overlap, microbatch_shape=mb_shape)
+            # FT104's scalar: the FLOP-priced idle-lane fraction (the
+            # SPMD body pays both lanes every tick; masked lanes are
+            # real matmuls on zeros). Packing exists to narrow this —
+            # the demo gate and the bench leg both track it.
+            from ..analysis.trace.dead_compute import dead_compute_stats
+            from .schedules import build_1f1b_schedule
+            stats["dead_compute_frac"] = round(dead_compute_stats(
+                build_1f1b_schedule(pipe, num_micro, spec["interleave"],
+                                    packed=packed, overlap=overlap)
+            )["dead_frac"], 6)
             loss, grads = step_fn(variables, batches[0])
         device_sync(loss)  # compile + warm step done
         grads_by_leg[name] = jax.tree_util.tree_map(np.asarray, grads)
@@ -957,6 +983,15 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                     f"{tag}/{name} bubble {stats['bubble_frac']} did not "
                     f"improve on GPipe's {gpipe['bubble_frac']} at equal M")
             if name.startswith("packed"):
+                twin = leg["schedules"].get(name.replace("packed_", ""))
+                if twin and not (stats.get("dead_compute_frac", 1.0)
+                                 < twin.get("dead_compute_frac", 0.0)):
+                    problems.append(
+                        f"{tag}/{name} dead-compute fraction "
+                        f"{stats.get('dead_compute_frac')} is not below "
+                        f"the unpacked schedule's "
+                        f"{twin.get('dead_compute_frac')} — packing "
+                        f"stopped narrowing the masked-lane waste")
                 if not stats.get("grads_bitwise_vs_unpacked"):
                     problems.append(
                         f"{tag}/{name} gradients are not bit-identical "

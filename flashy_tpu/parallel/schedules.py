@@ -45,6 +45,21 @@ PACKED_FORWARD_ERROR = (
     "schedule='1f1b' for pipelined forwards/inference.")
 
 
+def ring_perms(num_stages: int) -> tp.Tuple[tp.List[tp.Tuple[int, int]],
+                                            tp.List[tp.Tuple[int, int]]]:
+    """(forward, backward) `ppermute` permutations of the pipeline ring.
+
+    Activations hop +1 (stage i -> i+1 mod S), cotangents hop -1. The
+    single source of truth shared by the jitted pipeline bodies and the
+    FT102 trace auditor: the model check compares the permutations it
+    extracts from the traced jaxpr against exactly these tables, so the
+    program and the audit can never drift apart silently.
+    """
+    fwd = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    bwd = [(i, (i - 1) % num_stages) for i in range(num_stages)]
+    return fwd, bwd
+
+
 def bubble_fraction(num_stages: int, num_micro: int,
                     interleave: int = 1) -> float:
     """Ideal bubble fraction of the 1F1B family: (S-1)/(v*M + S-1).

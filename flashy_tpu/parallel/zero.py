@@ -132,6 +132,42 @@ def zero_update(grad_fn: tp.Callable, optimizer: tp.Any, *,
     return step
 
 
+def audit_expectations(state_spec: tp.Any, *,
+                       params_bytes: tp.Optional[int] = None
+                       ) -> tp.Dict[str, tp.Any]:
+    """The FT101 trace-audit contract of a step wrapped with this
+    module's shardings, derived MECHANICALLY from the declared spec.
+
+    `state_spec` is what `zero_sharding(state, mesh)` returned: every
+    leaf it shards must compile sharded (no silent replication
+    fallback), every leaf it leaves replicated must stay replicated,
+    the gradient reduction must exist in the HLO (a literal
+    reduce-scatter on TPU; CPU legally spells it all-reduce + slice)
+    and the fresh params must be re-gathered. With `params_bytes`, an
+    all-gather moving well beyond the params is flagged — that is the
+    opt state being gathered, the exact regression ZeRO-1 exists to
+    avoid. Feed the result to
+    `flashy_tpu.analysis.trace.AuditProgram(**expectations, ...)`.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(state_spec)
+    sharded: tp.List[str] = []
+    replicated: tp.List[str] = []
+    for path, sharding in flat:
+        spec = getattr(sharding, "spec", ())
+        is_sharded = any(part is not None for part in spec)
+        (sharded if is_sharded else replicated).append(
+            jax.tree_util.keystr(path))
+    out: tp.Dict[str, tp.Any] = {
+        "expect_sharded": tuple(sharded),
+        "expect_replicated": tuple(replicated),
+        "require_collectives": (("reduce-scatter", "all-reduce"),
+                                "all-gather"),
+    }
+    if params_bytes:
+        out["forbid_collectives"] = {"all-gather": int(params_bytes * 1.5)}
+    return out
+
+
 def per_device_bytes(tree: tp.Any) -> int:
     """Bytes ONE device holds for `tree`: each `jax.Array` leaf counts
     its per-device shard (via `sharding.shard_shape`, no data access);

@@ -59,12 +59,23 @@ class CompileCache:
     """
 
     def __init__(self, watchdog: tp.Optional[RecompileWatchdog] = None,
-                 tracer: tp.Optional[Tracer] = None):
+                 tracer: tp.Optional[Tracer] = None,
+                 record_signatures: bool = True):
         self.watchdog = watchdog or RecompileWatchdog(warmup=1)
         self.tracer = tracer
         self.hits = 0
         self.misses = 0
         self._fns: tp.Dict[Key, tp.Callable] = {}
+        # Per-executable distinct abstract call signatures (shape/dtype/
+        # weak-type tuples -> call count): the registry the FT103
+        # trace auditor consumes — a pre-flight "would these calls
+        # retrace" record. Costs one tree_flatten per call, so only
+        # the first `signature_sample` calls per executable pay it:
+        # warm-up + the audit sweep live there, and anything leaking a
+        # shape later is still caught by the runtime watchdog.
+        self.record_signatures = record_signatures
+        self.signature_sample = 64
+        self.signatures: tp.Dict[str, tp.Dict[tp.Tuple, int]] = {}
 
     def __contains__(self, key: Key) -> bool:
         return key in self._fns
@@ -91,6 +102,8 @@ class CompileCache:
         self.misses += 1
         name = self._name(key)
         fn = self.watchdog.watch(build(), name=name)
+        if self.record_signatures:
+            fn = self._with_signature_log(fn, name)
         self._fns[key] = fn
         logger.debug("compile cache miss: built %s", name)
         if self.tracer is not None:
@@ -115,6 +128,29 @@ class CompileCache:
                                   category="serve"):
                 return fn(*args, **kwargs)
         return fn(*args, **kwargs)
+
+    def _with_signature_log(self, fn: tp.Callable, name: str) -> tp.Callable:
+        import functools
+
+        from ..analysis.trace.recompile_risk import call_signature
+        log = self.signatures.setdefault(name, {})
+
+        @functools.wraps(fn)
+        def recorded(*args: tp.Any, **kwargs: tp.Any) -> tp.Any:
+            if sum(log.values()) < self.signature_sample:
+                sig = call_signature(args, kwargs)
+                log[sig] = log.get(sig, 0) + 1
+            return fn(*args, **kwargs)
+
+        recorded.watchdog_name = getattr(  # type: ignore[attr-defined]
+            fn, "watchdog_name", name)
+        return recorded
+
+    def executables(self) -> tp.Dict[str, tp.Callable]:
+        """{name: watched function} — the audit registry: every compiled
+        executable this cache manages, keyed by its watchdog name, with
+        its recorded call signatures in `signatures[name]`."""
+        return {self._name(key): fn for key, fn in self._fns.items()}
 
     def recompiles(self) -> int:
         """Total post-warm-up recompiles across all cached functions.

@@ -664,6 +664,15 @@ class DecodeEngine:
                                 cow=cow is not None)
         return start
 
+    def executables(self) -> tp.Dict[str, tp.Callable]:
+        """The audit registry: every compiled executable this engine
+        has built (decode / per-bucket prefill / verify / copy), keyed
+        by compile-cache name. `compile_cache.signatures[name]` holds
+        each one's recorded abstract call signatures — what the FT103
+        trace auditor checks for retrace risk, and what `warmup()`
+        plus a clean `compile_cache.recompiles()` proves covered."""
+        return self.compile_cache.executables()
+
     def pool_stats(self) -> tp.Optional[tp.Dict[str, float]]:
         """Block-pool occupancy/prefix counters plus bytes-per-token
         (None on the dense layout). `kv_bytes_per_token` is the pool

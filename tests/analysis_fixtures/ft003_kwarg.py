@@ -1,0 +1,17 @@
+# FT003 keyword-spelling fixture: `fault_point(site=...)` declares a
+# site exactly like the positional literal (chaos.fault_point's
+# signature allows both), so the first arm below is clean and only the
+# mistyped site is a violation.
+
+
+def fault_point(site, **context):
+    pass
+
+
+def install_probe():
+    fault_point(site="kwarg.local_site", detail=1)
+
+
+def arm(injector):
+    injector.fail_at("kwarg.local_site", call=1)       # declared above
+    injector.fail_at("kwarg.mistyped_site", call=1)    # nothing fires it

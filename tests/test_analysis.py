@@ -118,6 +118,19 @@ def test_ft003_good_fixture_clean():
     assert codes_for(findings, "ft003_good.py") == []
 
 
+def test_ft003_keyword_site_declaration():
+    # `fault_point(site=...)` declares the site like the positional
+    # spelling: the matching arm is clean, the typo'd one still flags
+    findings = analyze_fixtures(select=["FT003"])
+    bad = [f for f in findings if f.path == "ft003_kwarg.py"]
+    assert len(bad) == 1
+    assert "kwarg.mistyped_site" in bad[0].message
+    files = discover_files([FIXTURES / "ft003_kwarg.py"], FIXTURES)
+    from flashy_tpu.analysis.core import extract_fault_sites
+    sites, prefixes = extract_fault_sites(files[0])
+    assert sites == {"kwarg.local_site"} and prefixes == set()
+
+
 def test_ft004_fixtures():
     findings = analyze_fixtures(select=["FT004"])
     bad = codes_for(findings, "ft004_bad.py")
@@ -131,11 +144,25 @@ def test_ft005_fixtures():
     assert codes_for(findings, "ft005_good.py") == []
 
 
-def test_ft005_ops_match_accounting():
+def test_ft005_ops_superset_of_accounting():
     # the checker keeps its own copy (stdlib-only import graph); it must
-    # track the accounting module's op list exactly
+    # pin a SUPERSET of the accounting module's HLO op list — the only
+    # checker-side extra is the jaxpr-level `ppermute` spelling of
+    # collective-permute (the accounting module parses HLO text, where
+    # `ppermute` never appears, so it must NOT grow the alias)
     from flashy_tpu.parallel.accounting import COLLECTIVE_OPS as REAL_OPS
-    assert tuple(COLLECTIVE_OPS) == tuple(REAL_OPS)
+    assert set(REAL_OPS) <= set(COLLECTIVE_OPS)
+    assert set(COLLECTIVE_OPS) - set(REAL_OPS) == {"ppermute"}
+
+
+def test_ft005_flags_ppermute_scrape(tmp_path):
+    # counting ppermutes by text search has the same async double-count
+    # failure mode as its collective-permute lowering
+    (tmp_path / "probe.py").write_text(
+        "def hops(jaxpr_text):\n"
+        "    return jaxpr_text.count('ppermute')\n")
+    findings = analysis.analyze([tmp_path], tmp_path, select=["FT005"])
+    assert len(findings) == 1 and "ppermute" in findings[0].message
 
 
 def test_ft006_fixtures():
@@ -200,6 +227,31 @@ def test_baseline_survives_line_drift(tmp_path):
     assert findings and findings[0].line == 5
     assert new_findings(findings, {f.rel: f for f in files},
                         load_baseline(baseline_path)) == []
+
+
+def test_baseline_rename_surfaces_new_findings(tmp_path):
+    # fingerprints include the file path ON PURPOSE: moving a
+    # grandfathered violation to a new file is a new decision, not the
+    # old one following the line around — a rename must surface the
+    # finding again instead of silently matching the stale entry
+    root = tmp_path / "proj"
+    root.mkdir()
+    target = root / "mod.py"
+    target.write_text("def emit(tracer):\n"
+                      "    tracer.counter('BadTrack', n=1)\n")
+    files = discover_files([root], root)
+    findings, _ = run_checks(files, analysis.ALL_CHECKERS)
+    assert findings
+    baseline_path = tmp_path / "baseline.json"
+    save_baseline(baseline_path, findings, {f.rel: f for f in files})
+
+    target.rename(root / "renamed.py")  # identical content, new path
+    files = discover_files([root], root)
+    findings, _ = run_checks(files, analysis.ALL_CHECKERS)
+    fresh = new_findings(findings, {f.rel: f for f in files},
+                        load_baseline(baseline_path))
+    assert [f.path for f in fresh] == ["renamed.py"]
+    assert fresh[0].code == "FT006"
 
 
 # ----------------------------------------------------------------------
