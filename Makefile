@@ -39,6 +39,26 @@ analyze-trace:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m flashy_tpu.analysis --trace
 
+# Numerics-flow audit (flashy_tpu.analysis.numerics): trace the
+# registered hot programs (grad-accumulation + zero1 step, 1F1B
+# pipeline, paged int8 attention, speculative verify, datapipe seed
+# derivations) on 8 virtual CPU devices and run the FT201-FT204
+# auditors — accumulation dtype (narrow scan-carry/reduction
+# accumulators, complex-dropping casts), cast discipline (precision
+# round trips, downcasts into optimizer state), int8 quant-scale
+# placement (the scores/probs folding identity), and RNG discipline
+# (key single-use, pure (seed, k) host derivations). Exit 1 on any
+# NEW finding vs the committed .analysis-numerics-baseline.json.
+analyze-numerics:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m flashy_tpu.analysis --numerics
+
+# All three halves in one run — merged exit code, one summary table
+# (the individual targets above remain for scoped runs).
+analyze-all:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m flashy_tpu.analysis --all
+
 tests-all:
 	python -m pytest tests -x -q
 
@@ -136,4 +156,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all analyze analyze-trace coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo zero-demo pipeline-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo zero-demo pipeline-demo datapipe-demo docs native dist

@@ -4,9 +4,13 @@
 # Exit 2: usage/internal error. `--write-registry` regenerates the
 # committed fault-site registry; `--write-baseline` re-grandfathers
 # the current findings. `--trace` switches to the trace half
-# (FT101-FT104, `make analyze-trace`): it imports jax, builds the
-# zero/pipeline/serve demo programs on the current backend, runs the
-# trace auditors, and gates against the committed trace baseline.
+# (FT101-FT104, `make analyze-trace`); `--numerics` to the
+# numerics-flow half (FT201-FT204, `make analyze-numerics`) — both
+# import jax, build/trace the registered demo programs on the current
+# backend, and gate against their own committed baselines. `--all`
+# runs all three halves with one merged exit code and a single
+# summary table (`make analyze-all`). `--format sarif` emits SARIF
+# 2.1.0 of the NEW findings (all modes) so CI can annotate PRs inline.
 """CLI for the project-aware static analyzer."""
 from pathlib import Path
 import argparse
@@ -14,10 +18,11 @@ import sys
 import typing as tp
 
 from . import ALL_CHECKERS, checker_by_code
-from .baseline import (DEFAULT_BASELINE_NAME, load_baseline, new_findings,
-                       save_baseline)
+from .baseline import (DEFAULT_BASELINE_NAME, fingerprint, load_baseline,
+                       new_findings, save_baseline)
 from .core import build_index, discover_files, run_checks
 from .fault_sites import generate_registry_source
+from .sarif import sarif_payload, sarif_result, write_sarif
 
 
 def _default_root() -> Path:
@@ -33,7 +38,10 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
         description="Project-aware static lint: trace-leak, shape-policy, "
                     "fault-site, stateful-attr, collective-accounting and "
                     "telemetry-naming invariants (codes FT001-FT006). "
-                    "Suppress a single line with `# flashy: noqa[FTxxx]`.")
+                    "Suppress a single line with `# flashy: noqa[FTxxx]`. "
+                    "--trace runs the FT101-FT104 program auditors, "
+                    "--numerics the FT201-FT204 numerics-flow auditors, "
+                    "--all runs every half with one merged exit code.")
     parser.add_argument("paths", nargs="*", type=Path,
                         help="files/directories to scan (default: the "
                              "repo root containing flashy_tpu/)")
@@ -44,7 +52,9 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                         help="comma-separated checker codes to run")
     parser.add_argument("--baseline", type=Path, default=None,
                         help=f"baseline file (default: "
-                             f"<root>/{DEFAULT_BASELINE_NAME})")
+                             f"<root>/{DEFAULT_BASELINE_NAME}, or the "
+                             f"half's own default under --trace/"
+                             f"--numerics)")
     parser.add_argument("--no-baseline", action="store_true",
                         help="ignore the baseline: report every finding")
     parser.add_argument("--write-baseline", action="store_true",
@@ -61,18 +71,46 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                              "over the demo programs instead of the AST "
                              "checkers (requires jax + a multi-device "
                              "backend; see `make analyze-trace`)")
+    parser.add_argument("--numerics", action="store_true",
+                        help="run the numerics-flow auditors (FT201-FT204)"
+                             " over the registered hot programs (requires "
+                             "jax; see `make analyze-numerics`)")
+    parser.add_argument("--all", action="store_true",
+                        help="run AST + trace + numerics with one merged "
+                             "exit code and a single summary table "
+                             "(`make analyze-all`)")
     parser.add_argument("--legs", default=None, metavar="zero,pipeline",
-                        help="--trace only: comma-separated demo legs "
-                             "(default: zero,pipeline,serve)")
+                        help="--trace/--numerics only: comma-separated "
+                             "demo legs (defaults to every leg)")
+    parser.add_argument("--format", default="text",
+                        choices=("text", "sarif"), dest="output_format",
+                        help="findings format: human text (default) or "
+                             "SARIF 2.1.0 of the NEW findings (for "
+                             "GitHub code-scanning upload)")
+    parser.add_argument("--output", type=Path, default=None,
+                        help="write --format sarif output to this file "
+                             "instead of stdout")
     args = parser.parse_args(argv)
 
-    if args.trace:
-        return _trace_main(args)
-
-    if args.legs is not None:
-        print("error: --legs only applies to --trace runs",
+    if sum((args.trace, args.numerics, args.all)) > 1:
+        print("error: --trace, --numerics and --all are mutually "
+              "exclusive", file=sys.stderr)
+        return 2
+    if args.legs is not None and not (args.trace or args.numerics):
+        print("error: --legs only applies to --trace/--numerics runs",
               file=sys.stderr)
         return 2
+    if args.output is not None and args.output_format != "sarif":
+        print("error: --output only applies to --format sarif",
+              file=sys.stderr)
+        return 2
+
+    if args.all:
+        return _all_main(args)
+    if args.trace:
+        return _program_main(args, "trace")
+    if args.numerics:
+        return _program_main(args, "numerics")
 
     if args.list_checks:
         for checker in ALL_CHECKERS:
@@ -140,7 +178,11 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     else:
         fresh = new_findings(findings, by_rel, load_baseline(baseline_path))
 
-    if not args.quiet:
+    if args.output_format == "sarif":
+        _emit_sarif(args, [("source", f, _source_fp(f, by_rel))
+                           for f in fresh],
+                    {c.code: (c.name, c.explain) for c in checkers})
+    elif not args.quiet:
         for finding in fresh:
             print(finding.render())
     grandfathered = len(findings) - len(fresh)
@@ -150,58 +192,110 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
         summary += f", {grandfathered} baselined"
     if suppressed:
         summary += f", {len(suppressed)} suppressed (noqa)"
-    print(summary)
+    _summary_out(args, summary)
     return 1 if fresh else 0
 
 
-def _trace_main(args: tp.Any) -> int:
-    """The trace half's gate: sweep the demo programs, compare against
-    the committed trace baseline. Imported lazily — the AST half must
-    stay runnable (and importable) without jax."""
+# ----------------------------------------------------------------------
+# program halves (--trace / --numerics) + --all
+# ----------------------------------------------------------------------
+def _source_fp(finding: tp.Any, by_rel: tp.Mapping[str, tp.Any]) -> str:
+    file = by_rel.get(finding.path)
+    return fingerprint(finding,
+                       file.line_text(finding.line) if file else "")
+
+
+def _summary_out(args: tp.Any, summary: str) -> None:
+    """The summary line: stderr when SARIF owns stdout, else stdout."""
+    stream = sys.stderr if (args.output_format == "sarif"
+                            and args.output is None) else sys.stdout
+    print(summary, file=stream)
+
+
+def _emit_sarif(args: tp.Any,
+                entries: tp.Sequence[tp.Tuple[str, tp.Any, str]],
+                rules: tp.Mapping[str, tp.Tuple[str, str]]) -> None:
+    results = [sarif_result(kind, finding, fp)
+               for kind, finding, fp in entries]
+    write_sarif(sarif_payload(results, rules), args.output)
+    if args.output is not None:
+        print(f"wrote {args.output} ({len(results)} result(s))")
+
+
+def _load_half(name: str) -> tp.Dict[str, tp.Any]:
+    """The per-half adapter: module, baseline io, default legs. Imported
+    lazily — the AST half must stay runnable (and importable) without
+    jax."""
+    if name == "trace":
+        from . import trace as mod
+        return {"mod": mod, "label": "--trace",
+                "baseline_name": mod.DEFAULT_TRACE_BASELINE_NAME,
+                "save": mod.save_trace_baseline,
+                "load": mod.load_trace_baseline,
+                "new": mod.new_trace_findings,
+                "fingerprint": mod.trace_fingerprint,
+                "write_flag": "--trace --write-baseline"}
+    from . import numerics as mod
+    from .numerics import core as ncore
+    return {"mod": mod, "label": "--numerics",
+            "baseline_name": ncore.DEFAULT_NUMERICS_BASELINE_NAME,
+            "save": ncore.save_numerics_baseline,
+            "load": ncore.load_numerics_baseline,
+            "new": ncore.new_numerics_findings,
+            "fingerprint": ncore.numerics_fingerprint,
+            "write_flag": "--numerics --write-baseline"}
+
+
+def _program_main(args: tp.Any, half_name: str) -> int:
+    """The trace/numerics gate: sweep the registered programs, compare
+    against the half's committed baseline."""
     if args.paths:
-        print("error: --trace audits the demo programs, not source "
-              "paths; drop the positional arguments (scope with --legs "
-              "/ --select instead)", file=sys.stderr)
+        print(f"error: {'--trace' if half_name == 'trace' else '--numerics'}"
+              f" audits the demo programs, not source paths; drop the "
+              f"positional arguments (scope with --legs / --select "
+              f"instead)", file=sys.stderr)
         return 2
     if args.write_registry:
         print("error: --write-registry regenerates the AST half's "
-              "fault-site registry; run it without --trace",
+              "fault-site registry; run it without --trace/--numerics",
               file=sys.stderr)
         return 2
     try:
-        from . import trace
+        half = _load_half(half_name)
     except ImportError as exc:
-        print(f"error: --trace needs jax ({exc})", file=sys.stderr)
+        print(f"error: --{half_name} needs jax ({exc})", file=sys.stderr)
         return 2
+    mod = half["mod"]
 
     if args.list_checks:
-        for auditor in trace.ALL_AUDITORS:
+        for auditor in mod.ALL_AUDITORS:
             print(f"{auditor.code} {auditor.name}: {auditor.explain}")
         return 0
 
     root = (args.root or _default_root()).resolve()
     try:
-        auditors = (list(trace.ALL_AUDITORS) if args.select is None
-                    else [trace.auditor_by_code(code.strip())
+        auditors = (list(mod.ALL_AUDITORS) if args.select is None
+                    else [mod.auditor_by_code(code.strip())
                           for code in args.select.split(",") if code.strip()])
     except KeyError as exc:
         print(f"error: unknown auditor code {exc.args[0]!r}",
               file=sys.stderr)
         return 2
-    legs = (trace.SWEEP_LEGS if args.legs is None
+    legs = (mod.SWEEP_LEGS if args.legs is None
             else tuple(leg.strip() for leg in args.legs.split(",")
                        if leg.strip()))
     try:
-        programs = trace.demo_programs(legs)
+        programs = mod.demo_programs(legs)
     except (RuntimeError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
 
-    findings, suppressed = trace.run_auditors(programs, auditors)
-    baseline_path = (args.baseline
-                     or root / trace.DEFAULT_TRACE_BASELINE_NAME)
+    findings, suppressed = mod.run_auditors(programs, auditors) \
+        if half_name == "trace" \
+        else mod.run_numerics_auditors(programs, auditors)
+    baseline_path = args.baseline or root / half["baseline_name"]
     if args.write_baseline:
-        trace.save_trace_baseline(baseline_path, findings)
+        half["save"](baseline_path, findings)
         print(f"wrote {baseline_path} ({len(findings)} grandfathered "
               "findings)")
         return 0
@@ -209,20 +303,106 @@ def _trace_main(args: tp.Any) -> int:
     if args.no_baseline:
         fresh = list(findings)
     else:
-        fresh = trace.new_trace_findings(
-            findings, trace.load_trace_baseline(baseline_path))
-    if not args.quiet:
+        fresh = half["new"](findings, half["load"](baseline_path))
+    if args.output_format == "sarif":
+        _emit_sarif(args, [(half_name, f, half["fingerprint"](f))
+                           for f in fresh],
+                    {a.code: (a.name, a.explain) for a in auditors})
+    elif not args.quiet:
         for finding in fresh:
             print(finding.render())
     grandfathered = len(findings) - len(fresh)
-    summary = (f"flashy_tpu.analysis --trace: {len(programs)} programs, "
-               f"{len(fresh)} new finding(s)")
+    summary = (f"flashy_tpu.analysis {half['label']}: {len(programs)} "
+               f"programs, {len(fresh)} new finding(s)")
     if grandfathered:
         summary += f", {grandfathered} baselined"
     if suppressed:
         summary += f", {len(suppressed)} suppressed (noqa)"
-    print(summary)
+    _summary_out(args, summary)
     return 1 if fresh else 0
+
+
+def _all_main(args: tp.Any) -> int:
+    """AST + trace + numerics in one run: a merged exit code, one
+    summary table, and (with --format sarif) one merged document."""
+    for flag, value in (("positional paths", args.paths),
+                        ("--write-registry", args.write_registry),
+                        ("--write-baseline", args.write_baseline),
+                        ("--select", args.select),
+                        ("--baseline", args.baseline),
+                        ("--list-checks", args.list_checks)):
+        if value:
+            # --baseline included: the three halves gate against three
+            # DIFFERENT committed files, so one override path would be
+            # silently wrong for two of them
+            print(f"error: {flag} does not combine with --all; run the "
+                  "individual half instead", file=sys.stderr)
+            return 2
+    root = (args.root or _default_root()).resolve()
+    entries: tp.List[tp.Tuple[str, tp.Any, str]] = []
+    rules: tp.Dict[str, tp.Tuple[str, str]] = {}
+    rows: tp.List[tp.Tuple[str, str, int, int, int]] = []
+
+    # -- source half ----------------------------------------------------
+    files = discover_files([root], root)
+    findings, suppressed = run_checks(files, ALL_CHECKERS,
+                                      build_index(files))
+    by_rel = {f.rel: f for f in files}
+    fresh = (list(findings) if args.no_baseline else
+             new_findings(findings, by_rel,
+                          load_baseline(root / DEFAULT_BASELINE_NAME)))
+    entries += [("source", f, _source_fp(f, by_rel)) for f in fresh]
+    rules.update({c.code: (c.name, c.explain) for c in ALL_CHECKERS})
+    rows.append(("source", f"{len(files)} files", len(fresh),
+                 len(findings) - len(fresh), len(suppressed)))
+    if not args.quiet and args.output_format != "sarif":
+        for finding in fresh:
+            print(finding.render())
+
+    # -- program halves -------------------------------------------------
+    for half_name in ("trace", "numerics"):
+        try:
+            half = _load_half(half_name)
+        except ImportError as exc:
+            print(f"error: --all needs jax for the {half_name} half "
+                  f"({exc})", file=sys.stderr)
+            return 2
+        mod = half["mod"]
+        try:
+            programs = mod.demo_programs(mod.SWEEP_LEGS)
+        except (RuntimeError, ValueError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        auditors = list(mod.ALL_AUDITORS)
+        found, suppr = (mod.run_auditors(programs, auditors)
+                        if half_name == "trace"
+                        else mod.run_numerics_auditors(programs, auditors))
+        fresh_half = (list(found) if args.no_baseline else
+                      half["new"](found,
+                                  half["load"](root
+                                               / half["baseline_name"])))
+        entries += [(half_name, f, half["fingerprint"](f))
+                    for f in fresh_half]
+        rules.update({a.code: (a.name, a.explain) for a in auditors})
+        rows.append((half_name, f"{len(programs)} programs",
+                     len(fresh_half), len(found) - len(fresh_half),
+                     len(suppr)))
+        if not args.quiet and args.output_format != "sarif":
+            for finding in fresh_half:
+                print(finding.render())
+
+    if args.output_format == "sarif":
+        _emit_sarif(args, entries, rules)
+    total_new = sum(row[2] for row in rows)
+    stream = sys.stderr if (args.output_format == "sarif"
+                            and args.output is None) else sys.stdout
+    width = max(len(row[0]) for row in rows)
+    print(f"flashy_tpu.analysis --all: {total_new} new finding(s)",
+          file=stream)
+    for name, units, new, baselined, suppr in rows:
+        print(f"  {name:<{width}}  {units:>14}  new={new}  "
+              f"baselined={baselined}  suppressed={suppr}", file=stream)
+    return 1 if total_new else 0
 
 
 if __name__ == "__main__":

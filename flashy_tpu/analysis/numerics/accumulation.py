@@ -1,0 +1,139 @@
+# FT201 — accumulation dtype. The founding bug: `with_grad_accumulation`
+# once summed microbatch gradients in the gradients' OWN dtype, so a
+# bf16 model accumulated in bf16 and every addend past ~8 microbatches
+# lost its low mantissa bits against the grown partial sum — gradients
+# visibly drifted from the full-batch ones, caught by hand in PR 4.
+# The f32 fix then built its zeros in f32 unconditionally and
+# `astype`'d complex gradients into them, silently discarding every
+# imaginary part — the second PR 4 hand-find. Both are properties of
+# the TRACED program: the accumulator is a scan carry (or a reduce
+# operand) whose dtype only exists after tracing, where `eval_shape`
+# and dtype promotion have resolved what the source spells abstractly.
+# This auditor walks the ValueGraph for exactly those shapes: add-
+# updated scan carries narrower than f32, reduction operands narrower
+# than f32, and complex->real converts (the imag-dropping cast jax
+# itself only warns about, once, at trace time where nobody looks).
+"""FT201 accumulation-dtype: narrow accumulators, complex narrowing."""
+import typing as tp
+
+from .core import (ADD_PRIMS, DATA_MOVEMENT_PRIMS, REDUCTION_PRIMS,
+                   NumericsAuditor, NumericsFinding, NumericsProgram,
+                   is_complex, is_narrow_float)
+
+__all__ = ["AccumulationAuditor"]
+
+# An accumulator update is `carry_out = add(carry_in-ish, addend)` where
+# "-ish" allows pure data movement between the carry and the add — the
+# discriminator that keeps activation carries (overwritten, not added)
+# out of the findings.
+_CARRY_LINK_PRIMS = DATA_MOVEMENT_PRIMS | ADD_PRIMS
+
+
+class AccumulationAuditor(NumericsAuditor):
+    code = "FT201"
+    name = "accumulation-dtype"
+    explain = ("reduction chains (scan-carry accumulators, reduce/psum "
+               "operands) feeding program outputs must accumulate in "
+               ">= f32; complex->real converts silently drop the "
+               "imaginary part")
+
+    def audit(self, program: NumericsProgram
+              ) -> tp.Iterable[NumericsFinding]:
+        graph = program.graph()
+        if graph is None:
+            return
+        yield from self._audit_scan_carries(program, graph)
+        yield from self._audit_reductions(program, graph)
+        yield from self._audit_complex_narrowing(program, graph)
+
+    def _audit_scan_carries(self, program: NumericsProgram, graph
+                            ) -> tp.Iterable[NumericsFinding]:
+        for scan in graph.scans:
+            for index, (b_in, b_out, _outer_out, _init) in enumerate(
+                    scan.carries):
+                dtype = graph.dtype(b_out)
+                if not is_narrow_float(dtype):
+                    continue
+                if not self._is_add_accumulator(graph, b_in, b_out):
+                    continue  # an activation/state carry, not a sum
+                yield NumericsFinding(
+                    self.code, program.label,
+                    f"narrow-accum:{scan.context}carry[{index}]:{dtype}",
+                    f"scan carry #{index} (in {scan.context or 'top'}) is "
+                    f"an add-updated accumulator of dtype {dtype} — "
+                    f"narrower than f32, so each addend loses mantissa "
+                    f"bits against the growing partial sum (the pre-PR-4 "
+                    f"grad-accumulation drift past ~8 microbatches)",
+                    "build the running sum in f32 (f64/complex stay as "
+                    "they are) and cast back to the output dtype after "
+                    "the scan — what with_grad_accumulation._accum_dtype "
+                    "does")
+
+    def _is_add_accumulator(self, graph, b_in: tp.Any,
+                            b_out: tp.Any) -> bool:
+        """True when the carry feeds an add whose result becomes the
+        carry again, both through data movement only."""
+        from_carry = graph.forward([b_in], _CARRY_LINK_PRIMS, loop=False)
+        for node in graph.nodes_with_input(from_carry, ADD_PRIMS):
+            if graph.reaches(graph.node_out[node], {b_out},
+                             _CARRY_LINK_PRIMS):
+                return True
+        return False
+
+    def _audit_reductions(self, program: NumericsProgram, graph
+                          ) -> tp.Iterable[NumericsFinding]:
+        outvars = set(graph.outvars)
+        counter = 0
+        for node, prim in enumerate(graph.prims):
+            if prim == "reduce":
+                # generic lax.reduce: only an ADDITIVE monoid is an
+                # accumulation (bf16 max/min lose nothing)
+                body = graph.eqns[node].params.get("jaxpr")
+                body = getattr(body, "jaxpr", body)
+                if body is None or not any(
+                        sub.primitive.name in ADD_PRIMS
+                        for sub in body.eqns):
+                    continue
+            elif prim not in REDUCTION_PRIMS:
+                continue
+            narrow = [graph.dtype(v) for v in graph.node_in[node]
+                      if is_narrow_float(graph.dtype(v))]
+            if not narrow:
+                continue
+            # only reductions whose result the program actually returns
+            # (directly or transitively) matter — a narrow reduce in a
+            # dead branch is the dead-compute auditor's business
+            if not graph.reaches(graph.node_out[node], outvars):
+                continue
+            yield NumericsFinding(
+                self.code, program.label,
+                f"narrow-reduction:{prim}:{narrow[0]}#{counter}",
+                f"`{prim}` reduces a {narrow[0]} operand that flows to "
+                f"the program outputs — the reduction accumulates in the "
+                f"operand dtype, so gradient/loss mass below the bf16 "
+                f"mantissa floor is dropped before the optimizer sees it",
+                "upcast the operand to f32 before the reduction (XLA "
+                "fuses the convert into the reduce's operand read)")
+            counter += 1
+
+    def _audit_complex_narrowing(self, program: NumericsProgram, graph
+                                 ) -> tp.Iterable[NumericsFinding]:
+        counter = 0
+        for node, prim in enumerate(graph.prims):
+            if prim != "convert_element_type":
+                continue
+            src = [graph.dtype(v) for v in graph.node_in[node]]
+            dst = graph.eqns[node].params.get("new_dtype")
+            if not src or not is_complex(src[0]) or is_complex(dst):
+                continue
+            yield NumericsFinding(
+                self.code, program.label,
+                f"complex-narrowing:{src[0]}->{dst}#{counter}",
+                f"convert_element_type casts {src[0]} to {dst}, silently "
+                f"discarding the imaginary part (the post-PR-4 complex-"
+                f"gradient bug: an f32 accumulator `astype`s complex "
+                f"grads into itself and the imaginary gradient vanishes)",
+                "accumulate complex values in their own dtype "
+                "(_accum_dtype keeps complex as-is); spell a deliberate "
+                "real part jnp.real(), never astype")
+            counter += 1

@@ -1,0 +1,126 @@
+# FT202 — cast discipline. Two cast shapes launder precision without
+# changing a single magnitude visibly: (1) a round trip f32->bf16->f32
+# inside one program — the value's dtype says f32 again but its
+# mantissa was already truncated, so every downstream consumer trusts
+# precision that is gone; (2) a narrowing cast on the path from
+# gradients into optimizer/loss state — Adam moments kept in bf16 bias
+# every small update toward zero, and a bf16 master copy defeats the
+# entire mixed-precision contract (arXiv 2204.06514 keeps params,
+# grads-after-sync and opt state in f32 for exactly this reason).
+# Neither is a source property: `x.astype(dtype)` is innocent or fatal
+# depending on what dtype resolves to and where the value FLOWS, which
+# is ValueGraph reachability over the traced program.
+"""FT202 cast-discipline: precision round trips, downcasts into state."""
+import typing as tp
+
+from .core import (DATA_MOVEMENT_PRIMS, NumericsAuditor, NumericsFinding,
+                   NumericsProgram)
+
+__all__ = ["CastDisciplineAuditor"]
+
+
+def _float_bits(dtype: tp.Any) -> tp.Optional[int]:
+    import jax.numpy as jnp
+    import numpy as np
+    try:
+        np_dtype = np.dtype(dtype)
+    except TypeError:
+        return None
+    if not jnp.issubdtype(np_dtype, jnp.floating):
+        return None
+    return int(jnp.finfo(np_dtype).bits)
+
+
+# The round-trip search follows the narrowed value only through ops
+# that preserve it bit-for-bit; an intervening matmul or add makes the
+# widening a fresh computation, not a laundering of the same value.
+_PRESERVING = DATA_MOVEMENT_PRIMS - {"convert_element_type"}
+
+
+class CastDisciplineAuditor(NumericsAuditor):
+    code = "FT202"
+    name = "cast-discipline"
+    explain = ("no f32->narrow->f32 round trips laundering truncated "
+               "mantissas inside one program, and no narrowing casts on "
+               "paths into protected (optimizer/loss) outputs")
+
+    def audit(self, program: NumericsProgram
+              ) -> tp.Iterable[NumericsFinding]:
+        graph = program.graph()
+        if graph is None:
+            return
+        narrowing = []  # (node, src_bits, src_dtype, dst_dtype)
+        for node, prim in enumerate(graph.prims):
+            if prim != "convert_element_type" or not graph.node_in[node]:
+                continue
+            src = graph.dtype(graph.node_in[node][0])
+            dst = graph.eqns[node].params.get("new_dtype")
+            src_bits, dst_bits = _float_bits(src), _float_bits(dst)
+            if src_bits is None or dst_bits is None or dst_bits >= src_bits:
+                continue
+            narrowing.append((node, src_bits, src, dst))
+        yield from self._audit_round_trips(program, graph, narrowing)
+        yield from self._audit_protected_outputs(program, graph, narrowing)
+
+    def _audit_round_trips(self, program: NumericsProgram, graph,
+                           narrowing) -> tp.Iterable[NumericsFinding]:
+        counter = 0
+        for node, src_bits, src, dst in narrowing:
+            carried = graph.forward(graph.node_out[node], _PRESERVING)
+            for widen in graph.nodes_with_input(
+                    carried, frozenset({"convert_element_type"})):
+                back = graph.eqns[widen].params.get("new_dtype")
+                back_bits = _float_bits(back)
+                if back_bits is None or back_bits < src_bits:
+                    continue
+                yield NumericsFinding(
+                    self.code, program.label,
+                    f"dtype-roundtrip:{src}->{dst}->{back}#{counter}",
+                    f"a value is cast {src}->{dst} and then widened back "
+                    f"to {back} with only data movement in between — the "
+                    f"result reads as {back} but carries a {dst} "
+                    f"mantissa, laundering the truncation past every "
+                    f"downstream dtype check",
+                    "keep the value wide end-to-end, or narrow exactly "
+                    "once at the final store; if the round trip is a "
+                    "deliberate stochastic-rounding emulation, suppress "
+                    "with program noqa and say why")
+                counter += 1
+                break  # one finding per narrowing cast is enough
+
+    def _audit_protected_outputs(self, program: NumericsProgram, graph,
+                                 narrowing
+                                 ) -> tp.Iterable[NumericsFinding]:
+        if not program.protect_outputs:
+            return
+        protected = program.outvars_matching(program.protect_outputs)
+        if not protected:
+            if narrowing:
+                # same vacuity guard as FT101's no-audited-leaves: a
+                # protect pattern that matches nothing must be LOUD
+                yield NumericsFinding(
+                    self.code, program.label, "no-protected-outputs",
+                    f"none of the program's output paths match the "
+                    f"declared protect_outputs patterns "
+                    f"{list(program.protect_outputs)} — the downcast "
+                    f"audit is vacuous", "fix the path patterns")
+            return
+        counter = 0
+        inner_outvars = program.jaxpr.jaxpr.outvars
+        for node, _src_bits, src, dst in narrowing:
+            reached = graph.forward(graph.node_out[node]) & protected
+            if not reached:
+                continue
+            hit = next((path for path, var in zip(
+                program.out_paths or [], inner_outvars)
+                if (var, "") in reached), "?")
+            yield NumericsFinding(
+                self.code, program.label,
+                f"downcast-into-state:{src}->{dst}#{counter}",
+                f"a {src}->{dst} narrowing cast reaches the protected "
+                f"output {hit} — gradient/optimizer state downstream of "
+                f"this cast permanently loses the truncated bits (bf16 "
+                f"Adam moments bias small updates toward zero)",
+                "keep optimizer/loss state in f32; cast activations, "
+                "never the update path")
+            counter += 1

@@ -1,0 +1,175 @@
+# FT203 — quant-scale placement. The paged int8 cache's throughput win
+# rides one algebraic identity: a per-row scale is constant over the
+# contracted head_dim, so `(q . k_int8) * s == q . (k_int8 * s)` up to
+# float rounding — K scales FOLD into the [B, H, T, L] scores and V
+# scales into the probs, 1/head_dim the multiply work of dequantizing
+# the [B, L, H, Dh] gathered view. The correctness half of the
+# identity is placement: K's scale must land BETWEEN its contraction
+# and the softmax (after exp it no longer distributes), V's must land
+# AFTER the softmax and before the V contraction, and each exactly
+# once — a Pallas rewrite that dequantizes the view AND keeps the
+# scores multiply silently double-scales, and one that drops either
+# multiply silently un-scales; both decode plausible-looking garbage.
+# This auditor re-derives the placement structurally from the traced
+# jaxpr: find the contraction fed by the K payload, the softmax's exp
+# downstream of it, the contraction fed by the V payload, then
+# classify every multiply that consumes a scale (followed through data
+# movement only) by which region of that skeleton it lands in.
+"""FT203 quant-scale-placement: the int8 K/V scale-folding identity."""
+import typing as tp
+
+from .core import (DATA_MOVEMENT_PRIMS, NumericsAuditor, NumericsFinding,
+                   NumericsProgram)
+
+__all__ = ["QuantScaleAuditor"]
+
+_MUL = frozenset({"mul"})
+_DOT = frozenset({"dot_general"})
+_EXP = frozenset({"exp"})
+# The payload's path to its contraction may legally include a multiply
+# (a dequantize-then-dot rewrite — the very shape the unfolded/double
+# findings exist to catch), so the ANCHOR closure follows mul too;
+# scale identity tracking stays data-movement-only.
+_PAYLOAD_PRIMS = DATA_MOVEMENT_PRIMS | _MUL
+
+
+class QuantScaleAuditor(NumericsAuditor):
+    code = "FT203"
+    name = "quant-scale-placement"
+    explain = ("int8 K/V scales must fold into scores (K, pre-softmax) "
+               "and probs (V, post-softmax), each applied exactly once "
+               "on the correct side of its contraction")
+
+    def audit(self, program: NumericsProgram
+              ) -> tp.Iterable[NumericsFinding]:
+        graph = program.graph()
+        if graph is None:
+            return
+        roles = {role: program.invars_matching(needle)
+                 for role, needle in program.quant_roles.items()}
+        if not roles.get("k_scale") and not roles.get("v_scale"):
+            return  # not a quantized program — nothing to place
+        skeleton = self._skeleton(program, graph, roles)
+        if isinstance(skeleton, NumericsFinding):
+            yield skeleton
+            return
+        scores_dot, exps, out_dot = skeleton
+        pre_softmax = graph.backward(
+            [v for node in exps for v in graph.node_in[node]])
+        post_softmax = graph.forward(
+            [v for node in exps for v in graph.node_out[node]])
+        post_scores = graph.forward(
+            [v for node in scores_dot for v in graph.node_out[node]])
+        into_scores_dot = graph.backward(
+            [v for node in scores_dot for v in graph.node_in[node]])
+        into_out_dot = graph.backward(
+            [v for node in out_dot for v in graph.node_in[node]])
+        v_payload = graph.forward(roles.get("v", []), _PAYLOAD_PRIMS)
+
+        if roles.get("k_scale"):
+            yield from self._classify(
+                program, graph, "k", roles["k_scale"],
+                correct=lambda node: (
+                    set(graph.node_out[node]) & pre_softmax
+                    and set(graph.node_in[node]) & post_scores),
+                operand_side=lambda node: (
+                    set(graph.node_out[node]) & into_scores_dot),
+                expected="multiplied into the scores between the q.k "
+                         "contraction and the softmax")
+        if roles.get("v_scale"):
+            yield from self._classify(
+                program, graph, "v", roles["v_scale"],
+                correct=lambda node: (
+                    set(graph.node_in[node]) & post_softmax
+                    and set(graph.node_out[node]) & into_out_dot),
+                operand_side=lambda node: (
+                    set(graph.node_out[node]) & into_out_dot
+                    and set(graph.node_in[node]) & v_payload),
+                expected="multiplied into the probs between the softmax "
+                         "and the probs.v contraction")
+
+    def _skeleton(self, program: NumericsProgram, graph, roles):
+        """(scores-dot nodes, exp nodes, out-dot nodes) or a structure
+        finding when the attention skeleton cannot be identified — a
+        quantized program we cannot place scales in must be LOUD, not
+        vacuously clean."""
+        k_derived = graph.forward(roles.get("k", []), _PAYLOAD_PRIMS)
+        v_derived = graph.forward(roles.get("v", []), _PAYLOAD_PRIMS)
+        scores_dot = graph.nodes_with_input(k_derived, _DOT)
+        if not scores_dot:
+            return NumericsFinding(
+                self.code, program.label, "no-k-contraction",
+                "the program carries K quant scales but no dot_general "
+                "consumes the K payload (through data movement) — the "
+                "scale-placement audit cannot anchor",
+                "fix quant_roles path patterns, or audit the right fn")
+        post_scores = graph.forward(
+            [v for node in scores_dot for v in graph.node_out[node]])
+        exps = [node for node in graph.nodes_with_input(post_scores, _EXP)]
+        if not exps:
+            return NumericsFinding(
+                self.code, program.label, "no-softmax",
+                "no exp is downstream of the q.k contraction — the "
+                "softmax that separates the K side from the V side is "
+                "missing, so scale placement is unverifiable",
+                "fix quant_roles path patterns, or audit the right fn")
+        out_dot = [node for node in graph.nodes_with_input(v_derived, _DOT)
+                   if node not in scores_dot]
+        if not out_dot:
+            return NumericsFinding(
+                self.code, program.label, "no-v-contraction",
+                "the program carries V quant scales but no dot_general "
+                "consumes the V payload (through data movement)",
+                "fix quant_roles path patterns, or audit the right fn")
+        return scores_dot, exps, out_dot
+
+    def _classify(self, program: NumericsProgram, graph, role: str,
+                  scale_invars, correct, operand_side, expected: str
+                  ) -> tp.Iterable[NumericsFinding]:
+        scale_derived = graph.forward(scale_invars, DATA_MOVEMENT_PRIMS)
+        apps = graph.nodes_with_input(scale_derived, _MUL)
+        if not apps:
+            yield NumericsFinding(
+                self.code, program.label, f"unscaled:{role}",
+                f"the {role} quant scale is an input but no multiply "
+                f"ever applies it — the int8 payload is contracted "
+                f"UN-scaled and every magnitude is off by the per-row "
+                f"absmax",
+                f"the scale must be {expected}")
+            return
+        correct_apps = [node for node in apps if correct(node)]
+        operand_apps = [node for node in apps
+                        if node not in correct_apps and operand_side(node)]
+        wrong_apps = [node for node in apps
+                      if node not in correct_apps
+                      and node not in operand_apps]
+        if wrong_apps:
+            yield NumericsFinding(
+                self.code, program.label, f"wrong-side:{role}",
+                f"a multiply applies the {role} quant scale on the "
+                f"wrong side of the softmax — the scale no longer "
+                f"distributes over its contraction there "
+                f"(exp(s*x) != s*exp(x)), so the attention weights are "
+                f"structurally wrong, not just imprecise",
+                f"the scale must be {expected}")
+        if len(correct_apps) + len(operand_apps) > 1:
+            yield NumericsFinding(
+                self.code, program.label, f"double-scale:{role}",
+                f"the {role} quant scale is applied "
+                f"{len(correct_apps) + len(operand_apps)} times on its "
+                f"contraction path — the classic fused-kernel-rewrite "
+                f"bug: the new kernel dequantizes the gathered view AND "
+                f"keeps the folded multiply, squaring the scale",
+                "apply each scale exactly once; delete the redundant "
+                "multiply")
+        elif operand_apps and not correct_apps:
+            yield NumericsFinding(
+                self.code, program.label, f"unfolded-scale:{role}",
+                f"the {role} quant scale multiplies the gathered "
+                f"[.., head_dim] payload instead of folding into the "
+                f"post-contraction tensor — numerically equal, but the "
+                f"multiply runs at head_dim times the work and "
+                f"materializes a dequantized view the folded form never "
+                f"builds (the perf half of the FT203 identity)",
+                f"fold it: the scale is constant over the contracted "
+                f"head_dim, so it must be {expected}")

@@ -1,0 +1,188 @@
+# FT204 — RNG discipline. Two contracts, one device-side and one
+# host-side. Device: a PRNG key is single-use — sampling from the same
+# key twice yields IDENTICAL "randomness" (correlated dropout masks,
+# repeated noise across microbatches: the bug class
+# `with_grad_accumulation(fold_rng=True)` exists to prevent). In the
+# jaxpr this is visible as one key identity consumed by >=2 sampling
+# primitives — or, the loop variant, a key that enters a scan body
+# from OUTSIDE (const or pass-through carry) and is sampled inside
+# without a fold_in of anything loop-varying: consumed "once" in the
+# program text, once PER ITERATION at runtime. Host: the datapipe's
+# resume-exactness proof (bit-identical consumed-token streams across
+# SIGTERM) requires every seed derivation to be a pure function of
+# (seed, k) — MixtureStream spells it SeedSequence([seed, k]). A
+# derivation that consults global RNG state or ignores k silently
+# breaks stream replay in a way no jaxpr shows, so this auditor probes
+# registered derivations dynamically: same (seed, k) twice must agree
+# (with the global RNGs deliberately perturbed in between), and k must
+# actually enter the derivation.
+"""FT204 rng-discipline: key single-use, pure host seed derivations."""
+import typing as tp
+
+from .core import NumericsAuditor, NumericsFinding, NumericsProgram, \
+    is_prng_key
+
+__all__ = ["RngDisciplineAuditor"]
+
+# Sampling primitives that CONSUME a key (same key -> same bits).
+_CONSUMERS = frozenset({"random_bits", "threefry2x32"})
+# Primitives that derive a NEW key identity from an old one.
+_DERIVERS = frozenset({"random_fold_in", "random_split", "random_seed"})
+# Selection out of a key ARRAY: `keys[0]` and `keys[1]` after a split
+# are DIFFERENT keys, so each selecting eqn mints its own identity
+# (derived per eqn — the same selected var consumed twice still counts
+# as reuse, two distinct selections do not).
+_SELECTORS = frozenset({"slice", "dynamic_slice", "gather"})
+# Ops a key identity survives unchanged.
+_IDENTITY = frozenset({
+    "random_wrap", "random_unwrap", "convert_element_type", "reshape",
+    "broadcast_in_dim", "squeeze", "expand_dims", "transpose", "copy",
+    "concatenate",
+})
+
+_LOOP_MARKS = ("scan@", "while@")
+
+
+def _loop_depth(context: str) -> int:
+    return sum(context.count(mark) for mark in _LOOP_MARKS)
+
+
+class RngDisciplineAuditor(NumericsAuditor):
+    code = "FT204"
+    name = "rng-discipline"
+    explain = ("a PRNG key must feed at most one sampling primitive "
+               "(and never be sampled inside a loop it didn't fold the "
+               "index into); host seed derivations must be pure "
+               "functions of (seed, k)")
+
+    def audit(self, program: NumericsProgram
+              ) -> tp.Iterable[NumericsFinding]:
+        graph = program.graph()
+        if graph is not None:
+            yield from self._audit_key_use(program, graph)
+        yield from self._audit_seed_fns(program)
+
+    # -- device side ----------------------------------------------------
+    def _audit_key_use(self, program: NumericsProgram, graph
+                       ) -> tp.Iterable[NumericsFinding]:
+        origin: tp.Dict[tp.Any, tp.Tuple[str, str]] = {}
+
+        def name_for(var, index: int, kind: str) -> tp.Tuple[str, str]:
+            if program.in_paths is not None and kind == "input":
+                try:
+                    return (f"key:{program.in_paths[graph.invars.index(var)]}",
+                            "")
+                except ValueError:
+                    pass
+            return (f"{kind}#{index}", "")
+
+        for index, token in enumerate(graph.invars + graph.constvars):
+            if is_prng_key(graph.aval(token)):
+                origin[token] = name_for(token, index, "input")
+
+        # one pass in walk order (topological per context; loop-back
+        # aliases are deliberately ignored — first-iteration identity
+        # is what the single-use rule is about)
+        consumed: tp.Dict[tp.Tuple[str, str], tp.List[tp.Tuple[int, str]]] \
+            = {}
+        for node, prim in enumerate(graph.prims):
+            ins = graph.node_in[node]
+            outs = graph.node_out[node]
+            key_ins = [v for v in ins if v in origin]
+            # propagate through boundary aliases first (walk order puts
+            # the alias source before the sub-jaxpr's eqns)
+            if prim in _DERIVERS or (key_ins and prim in _SELECTORS):
+                for out in outs:
+                    origin[out] = (f"{prim}#{node}", graph.contexts[node])
+            elif key_ins and prim in _IDENTITY:
+                for out in outs:
+                    origin[out] = origin[key_ins[0]]
+            elif key_ins and prim in _CONSUMERS:
+                consumed.setdefault(origin[key_ins[0]], []).append(
+                    (node, graph.contexts[node]))
+            for var in list(origin):
+                for dst in graph.fwd_alias.get(var, []):
+                    origin.setdefault(dst, origin[var])
+
+        for (name, def_context), uses in sorted(consumed.items()):
+            if len(uses) >= 2:
+                yield NumericsFinding(
+                    self.code, program.label, f"key-reuse:{name}",
+                    f"PRNG key {name} is consumed by {len(uses)} "
+                    f"sampling primitives in one traced program — both "
+                    f"draws return IDENTICAL bits (correlated dropout "
+                    f"masks / repeated noise), not fresh randomness",
+                    "split or fold_in before every independent use; one "
+                    "key, one sample")
+                continue
+            node, use_context = uses[0]
+            if _loop_depth(use_context) > _loop_depth(def_context):
+                yield NumericsFinding(
+                    self.code, program.label, f"key-reuse-in-loop:{name}",
+                    f"PRNG key {name} enters a {use_context.split('@')[0]}"
+                    f" body from outside and is sampled inside without a "
+                    f"fold_in — consumed once in the program text, once "
+                    f"PER ITERATION at runtime, so every iteration draws "
+                    f"the same bits (the repeated-dropout-mask bug "
+                    f"with_grad_accumulation's fold_rng exists to stop)",
+                    "fold the loop index into the key inside the body "
+                    "(jax.random.fold_in(key, i)), or thread a split key "
+                    "through the carry")
+
+    # -- host side ------------------------------------------------------
+    def _audit_seed_fns(self, program: NumericsProgram
+                        ) -> tp.Iterable[NumericsFinding]:
+        if not program.seed_fns:
+            return
+        import random as py_random
+
+        import numpy as np
+        for name, fn in sorted(program.seed_fns.items()):
+            py_state = py_random.getstate()
+            np_state = np.random.get_state()
+            try:
+                first = fn(1234, 7)
+                # perturb every global RNG a lazy derivation might
+                # lean on; a pure function of (seed, k) cannot notice
+                py_random.seed(99991)
+                np.random.seed(99991)
+                second = fn(1234, 7)
+                draws = [fn(1234, k) for k in range(program.seed_samples)]
+            except Exception as exc:  # noqa: BLE001 — probe must not crash
+                yield NumericsFinding(
+                    self.code, program.label, f"seed-probe-failed:{name}",
+                    f"seed derivation {name} raised under the purity "
+                    f"probe: {type(exc).__name__}: {exc}",
+                    "the derivation must accept (seed, k) and return a "
+                    "comparable value")
+                continue
+            finally:
+                py_random.setstate(py_state)
+                np.random.set_state(np_state)
+            if not _same(first, second):
+                yield NumericsFinding(
+                    self.code, program.label, f"impure-seed:{name}",
+                    f"seed derivation {name} returned different values "
+                    f"for the same (seed, k) across calls — it consults "
+                    f"hidden state (global RNG, time, ...), so a resumed "
+                    f"datapipe cannot replay draw k bit-identically",
+                    "derive from np.random.SeedSequence([seed, k]) (what "
+                    "MixtureStream does); no global RNG, no clocks")
+            elif len(draws) > 1 and all(_same(d, draws[0])
+                                        for d in draws[1:]):
+                yield NumericsFinding(
+                    self.code, program.label, f"k-insensitive-seed:{name}",
+                    f"seed derivation {name} ignores the draw counter k "
+                    f"(identical output for k=0..{program.seed_samples - 1})"
+                    f" — every draw replays the SAME randomness, the "
+                    f"host-side analogue of sampling one key in a loop",
+                    "fold k into the derivation: "
+                    "np.random.SeedSequence([seed, k])")
+
+
+def _same(a: tp.Any, b: tp.Any) -> bool:
+    import numpy as np
+    try:
+        return bool(np.all(np.asarray(a) == np.asarray(b)))
+    except Exception:  # noqa: BLE001 — incomparable values differ
+        return a == b

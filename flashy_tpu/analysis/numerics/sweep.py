@@ -1,0 +1,72 @@
+# The live sweep `python -m flashy_tpu.analysis --numerics` / `make
+# analyze-numerics` runs: collect the registered hot programs from the
+# per-subsystem audit registries (parallel.audit, models.audit,
+# datapipe.audit — the `DecodeEngine.executables()` pattern extended
+# to training and the model zoo), trace each into a jaxpr, and hand
+# them to every FT2xx auditor. Like the trace sweep, programs are
+# shrunken but faithful: accumulator dtypes, cast paths, scale
+# placement and key folding are shape-class facts — a 16-dim MLP
+# accumulating in bf16 is the same bug a 70B run has.
+"""Registered-program sweep for the numerics auditors (FT201-FT204)."""
+import typing as tp
+
+from .core import NumericsProgram
+
+__all__ = ["demo_programs", "SWEEP_LEGS"]
+
+# leg -> registry; each registry returns NumericsProgram kwargs dicts
+# whose labels are prefixed `leg/...` (the sweep asserts that, so a
+# registry cannot silently contribute to the wrong leg)
+SWEEP_LEGS = ("train", "pipeline", "attention", "serve", "datapipe")
+
+
+def _require_devices(minimum: int) -> None:
+    import jax
+    n = len(jax.devices())
+    if n < minimum:
+        raise RuntimeError(
+            f"the numerics sweep traces multi-device programs and found "
+            f"only {n} device(s); run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu "
+            f"(what `make analyze-numerics` does)")
+
+
+def _registry_entries(legs: tp.Sequence[str]
+                      ) -> tp.List[tp.Dict[str, tp.Any]]:
+    """Entries from every registry that owns a requested leg (a
+    registry may serve two legs; it is built once)."""
+    entries: tp.List[tp.Dict[str, tp.Any]] = []
+    if "train" in legs or "pipeline" in legs:
+        from ...parallel.audit import numerics_audit_programs
+        entries += numerics_audit_programs()
+    if "attention" in legs or "serve" in legs:
+        from ...models.audit import numerics_audit_programs
+        entries += numerics_audit_programs()
+    if "datapipe" in legs:
+        from ...datapipe.audit import numerics_audit_programs
+        entries += numerics_audit_programs()
+    return entries
+
+
+def demo_programs(legs: tp.Sequence[str] = SWEEP_LEGS
+                  ) -> tp.List[NumericsProgram]:
+    """Build (and trace) the registered audit programs for `legs`."""
+    unknown = [leg for leg in legs if leg not in SWEEP_LEGS]
+    if unknown:
+        raise ValueError(f"unknown sweep leg(s) {unknown}; "
+                         f"pick from {list(SWEEP_LEGS)}")
+    if any(leg in legs for leg in ("train", "pipeline")):
+        _require_devices(2)
+    programs: tp.List[NumericsProgram] = []
+    for entry in _registry_entries(legs):
+        leg = entry["label"].split("/", 1)[0]
+        if leg not in SWEEP_LEGS:
+            raise ValueError(
+                f"registry entry {entry['label']!r} does not belong to "
+                f"a known sweep leg {list(SWEEP_LEGS)}")
+        if leg not in legs:
+            continue
+        program = NumericsProgram(**entry)
+        program.ensure_traced()
+        programs.append(program)
+    return programs

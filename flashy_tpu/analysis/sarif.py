@@ -1,0 +1,101 @@
+# SARIF 2.1.0 emission for all three analyzer halves, so findings
+# annotate GitHub PRs inline instead of living in a CI log nobody
+# opens. AST findings carry a real (file, line, col) and render as
+# inline annotations; trace/numerics findings are properties of a
+# PROGRAM, not a file, so they anchor physically at the sweep module
+# that builds the audited program (the closest thing to a source of
+# truth a reviewer can click) and carry the program label as a logical
+# location. Stable fingerprints ride along as partialFingerprints so
+# code-scanning's dedup tracks findings the same way the committed
+# baselines do. Stdlib-only on purpose — the AST half's CI lane emits
+# SARIF without jax installed.
+"""SARIF 2.1.0 output for the flashy_tpu analyzers."""
+import json
+import typing as tp
+
+__all__ = ["sarif_payload", "sarif_result", "write_sarif"]
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+# Where program-level (trace/numerics) findings anchor physically:
+# the sweep that built the audited program.
+PROGRAM_ANCHORS = {
+    "trace": "flashy_tpu/analysis/trace/sweep.py",
+    "numerics": "flashy_tpu/analysis/numerics/sweep.py",
+}
+
+
+def sarif_result(kind: str, finding: tp.Any,
+                 fingerprint: str) -> tp.Dict[str, tp.Any]:
+    """One SARIF result from a Finding (`kind='source'`) or a
+    Trace/NumericsFinding (`kind` in 'trace'/'numerics')."""
+    message = finding.message
+    if getattr(finding, "hint", ""):
+        message += f" [hint: {finding.hint}]"
+    if kind == "source":
+        location = {
+            "physicalLocation": {
+                "artifactLocation": {"uri": finding.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(finding.line, 1),
+                           "startColumn": finding.col + 1},
+            },
+        }
+    else:
+        location = {
+            "physicalLocation": {
+                "artifactLocation": {"uri": PROGRAM_ANCHORS[kind],
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": 1, "startColumn": 1},
+            },
+            "logicalLocations": [{"name": finding.program,
+                                  "kind": "module"}],
+        }
+        message = f"[{finding.program}] {message}"
+    return {
+        "ruleId": finding.code,
+        "level": "error",
+        "message": {"text": message},
+        "locations": [location],
+        "partialFingerprints": {"flashyFingerprint/v1": fingerprint},
+    }
+
+
+def sarif_payload(results: tp.Sequence[tp.Dict[str, tp.Any]],
+                  rules: tp.Mapping[str, tp.Tuple[str, str]]
+                  ) -> tp.Dict[str, tp.Any]:
+    """The full SARIF document for pre-built `results`; `rules` maps
+    FT-code -> (name, explanation) for every checker/auditor that ran
+    (not just those that fired — code scanning shows the rule set)."""
+    rule_entries = [
+        {"id": code,
+         "name": name,
+         "shortDescription": {"text": name},
+         "fullDescription": {"text": explain},
+         "defaultConfiguration": {"level": "error"}}
+        for code, (name, explain) in sorted(rules.items())]
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "flashy_tpu.analysis",
+                "informationUri":
+                    "https://github.com/facebookresearch/flashy",
+                "rules": rule_entries,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": list(results),
+        }],
+    }
+
+
+def write_sarif(payload: tp.Dict[str, tp.Any],
+                output: tp.Optional[tp.Any]) -> None:
+    """Write the document to `output` (a Path) or stdout."""
+    text = json.dumps(payload, indent=2, sort_keys=False) + "\n"
+    if output is None:
+        print(text, end="")
+    else:
+        output.write_text(text)

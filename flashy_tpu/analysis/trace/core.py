@@ -144,16 +144,18 @@ def load_trace_baseline(path: Path) -> tp.Dict[str, int]:
 
 
 def save_trace_baseline(path: Path,
-                        findings: tp.Sequence[TraceFinding]) -> None:
+                        findings: tp.Sequence[TraceFinding],
+                        comment: tp.Optional[str] = None) -> None:
     import collections
     import json
     counter: tp.Counter = collections.Counter(
         trace_fingerprint(f) for f in findings)
     payload = {
         "version": 1,
-        "comment": ("flashy_tpu.analysis trace baseline — grandfathered "
-                    "FT1xx findings; the gate is 'no NEW findings'. "
-                    "Regenerate with --trace --write-baseline."),
+        "comment": comment or (
+            "flashy_tpu.analysis trace baseline — grandfathered "
+            "FT1xx findings; the gate is 'no NEW findings'. "
+            "Regenerate with --trace --write-baseline."),
         "entries": dict(sorted(counter.items())),
     }
     path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")

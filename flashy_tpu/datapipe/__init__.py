@@ -7,6 +7,7 @@
 # acceptance drill proving it).
 # flake8: noqa
 """flashy_tpu.datapipe: sharded streaming, packing, mixtures, exact resume."""
+from .audit import numerics_audit_programs
 from .iterator import CheckpointableIterator, PipelineStage
 from .mixture import MixtureStream
 from .packing import SequencePacker

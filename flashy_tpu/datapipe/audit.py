@@ -1,0 +1,35 @@
+# The datapipe's numerics-audit registry: the resume-exactness
+# contract is that every host-side seed derivation is a pure function
+# of (seed, k) — MixtureStream's draw k spells it
+# `default_rng(SeedSequence([seed, k]))`, which is why a SIGTERM'd run
+# replays draw k bit-identically after restore. FT204 probes the REAL
+# code path (not a re-spelling of it): the registered derivation
+# constructs a MixtureStream and asks for `_pick(k)`, so a future
+# refactor that sneaks global RNG state or a k-independent seed into
+# the mixture breaks the audit the same day it breaks resume.
+"""Numerics-audit program registry for the datapipe."""
+import typing as tp
+
+__all__ = ["numerics_audit_programs"]
+
+
+def _mixture_pick(seed: int, k: int) -> int:
+    from .mixture import MixtureStream
+    stream = MixtureStream([iter(()), iter(()), iter(())],
+                           [0.5, 0.3, 0.2], seed=seed)
+    index = stream._pick(k)
+    return -1 if index is None else index
+
+
+def numerics_audit_programs() -> tp.List[tp.Dict[str, tp.Any]]:
+    """NumericsProgram kwargs for the host-side datapipe contracts
+    (labels `datapipe/...`): no jaxpr — these are pure FT204
+    seed-derivation probes."""
+    return [{
+        "label": "datapipe/mixture-pick",
+        "seed_fns": {"MixtureStream._pick": _mixture_pick},
+        # 16 draws: with 3 weighted sources the chance a HEALTHY
+        # derivation returns one index 16 times is < 0.5^15 — the
+        # k-insensitivity probe must not flake
+        "seed_samples": 16,
+    }]

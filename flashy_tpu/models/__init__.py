@@ -14,3 +14,4 @@ from .quantize import (quantize_lm_params, dequantize_lm_params,
                        is_quantized)
 from .pipelined import (pipelined_apply, pipelined_value_and_grad,
                         sequential_value_and_grad)
+from .audit import numerics_audit_programs

@@ -1,0 +1,111 @@
+# The model zoo's numerics-audit registry — the `models/`+`ops/` half
+# of the per-program hooks (`DecodeEngine.executables()` covers the
+# engine's compiled registry; `parallel.audit` covers training). The
+# serving-side numerics contracts live here: the paged int8 attention
+# whose scale-folding identity FT203 structurally verifies (so a
+# future Pallas rewrite cannot silently double- or un-scale), and the
+# speculative verify forward whose rejection-sampling path is the one
+# place serve consumes PRNG keys under load. Entries are plain dicts —
+# never analysis types — so the dependency only points analysis ->
+# models.
+"""Numerics-audit program registry for models/ and ops/."""
+import typing as tp
+
+__all__ = ["numerics_audit_programs"]
+
+
+def numerics_audit_programs() -> tp.List[tp.Dict[str, tp.Any]]:
+    """NumericsProgram kwargs for the serving-side hot programs: the
+    gather-based paged int8 attention (labels `attention/...`) and the
+    [S, k+1] speculative verify (labels `serve/...`)."""
+    return _attention_entries() + _verify_entries()
+
+
+def _attention_entries() -> tp.List[tp.Dict[str, tp.Any]]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.paged_attention import paged_attention, paged_write
+
+    num_blocks, block_size, heads, head_dim = 4, 4, 2, 8
+    batch, queries, entries = 2, 1, 3
+    key = jax.random.PRNGKey(0)
+    shape = (num_blocks, block_size, heads, head_dim)
+    entry = {
+        "k": jax.random.randint(key, shape, -127, 127, jnp.int32
+                                ).astype(jnp.int8),
+        "v": jax.random.randint(key, shape, -127, 127, jnp.int32
+                                ).astype(jnp.int8),
+        "k_scale": jnp.ones(shape[:-1], jnp.float32) / 127.0,
+        "v_scale": jnp.ones(shape[:-1], jnp.float32) / 127.0,
+    }
+    q = jax.random.normal(key, (batch, queries, heads, head_dim),
+                          jnp.float32)
+    table = jnp.asarray([[1, 2, 0], [3, 0, 0]], jnp.int32)
+    positions = jnp.asarray([[5], [2]], jnp.int32)
+
+    def attend(q_in, entry_in, table_in, positions_in):
+        return paged_attention(q_in, entry_in, table_in, positions_in,
+                               head_dim=head_dim, dtype=jnp.float32)
+
+    new_k = jax.random.normal(key, (batch, queries, heads, head_dim),
+                              jnp.float32)
+
+    def write(entry_in, new_k_in, new_v_in, table_in, positions_in):
+        return paged_write(entry_in, new_k_in, new_v_in, table_in,
+                           positions_in)
+
+    return [
+        {"label": "attention/paged-int8",
+         "fn": attend,
+         "example_args": (q, entry, table, positions)},
+        {"label": "attention/paged-int8-write",
+         "fn": write,
+         "example_args": (entry, new_k, new_k, table, positions),
+         # the write path PRODUCES scales (quantize-on-write); there is
+         # no contraction here for FT203 to place them against
+         "quant_roles": {}},
+    ]
+
+
+def _verify_entries() -> tp.List[tp.Dict[str, tp.Any]]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .decoding import _apply_step, init_cache, speculative_acceptance
+    from .transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=32, dim=16, num_layers=2,
+                            num_heads=2, attention="dense",
+                            max_seq_len=32, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 4), jnp.int32))
+    slots, k = 2, 3
+    cache = init_cache(cfg, slots, cfg.max_seq_len)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 32, (slots,)), jnp.int32)
+    drafts = jnp.asarray(rng.integers(0, 32, (slots, k)), jnp.int32)
+    positions = jnp.asarray([4, 7], jnp.int32)
+    key = jax.random.key(0)
+
+    def verify(params_in, cache_in, tokens_in, drafts_in, positions_in,
+               key_in):
+        # the engine's [S, k+1] verify contract (serve/engine.py
+        # _build_verify), rejection-sampling leg included so the
+        # audited program consumes keys the way production does
+        toks = jnp.concatenate([tokens_in[:, None], drafts_in], axis=1)
+        pos = positions_in[:, None] \
+            + jnp.arange(k + 1, dtype=jnp.int32)[None]
+        logits, cache_out = _apply_step(model, params_in, cfg, toks, pos,
+                                        cache_in, positions_in)
+        out, accepted = speculative_acceptance(
+            drafts_in, logits, temperature=0.8, rng=key_in)
+        return out, accepted, cache_out
+
+    return [{
+        "label": "serve/speculative-verify",
+        "fn": verify,
+        "example_args": (params, cache, tokens, drafts, positions, key),
+    }]

@@ -34,7 +34,7 @@ def _quantize(w: jax.Array, contract_axes: tp.Sequence[int]) -> tp.Dict:
     """Symmetric absmax int8 over `contract_axes` (scale per out-channel)."""
     w = w.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(w), axis=tuple(contract_axes), keepdims=True)
-    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    scale = _safe_scale(absmax)
     q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
     return {"q": q, "scale": scale.astype(jnp.float32)}
 
@@ -122,17 +122,32 @@ def quantize_lm_params(params: tp.Any, *,
 # fuses into the attention gather instead of materializing a
 # dequantized pool copy in HBM.
 
+def _safe_scale(absmax: jax.Array) -> jax.Array:
+    """absmax -> quant scale with a clamped denominator.
+
+    An all-zero row (the paged pool's sentinel block, a zero-init
+    cache, a dead head) has absmax 0: dividing by `absmax / 127` raw
+    is inf/NaN, and an epsilon clamp alone still hands downstream math
+    a ~8e-15 scale whose reciprocal (or bf16 square) overflows. Zero
+    rows carry no information, so they get a unit scale: q == 0 and
+    the dequantized row is EXACTLY zero, no matter what dtype touches
+    the scale later.
+    """
+    return jnp.where(absmax > 0, jnp.maximum(absmax, 1e-12), 127.0) / 127.0
+
+
 def quantize_kv(x: jax.Array) -> tp.Tuple[jax.Array, jax.Array]:
     """Quantize K or V rows `[..., head_dim]` to int8 + per-row scale.
 
     Symmetric absmax over the trailing head_dim (one scale per cache
     row per head, stored beside the pool by the paged cache); exact
     inverse up to rounding: `dequantize_kv(*quantize_kv(x))` ~= x with
-    relative error <= 1/254 per element.
+    relative error <= 1/254 per element. All-zero rows quantize to
+    (q=0, scale=1) — see `_safe_scale`.
     """
     xf = x.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
-    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    scale = _safe_scale(absmax)
     q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
     return q, scale[..., 0].astype(jnp.float32)
 

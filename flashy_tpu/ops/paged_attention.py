@@ -179,7 +179,11 @@ def paged_attention(q: jax.Array, entry: tp.Dict, table: jax.Array,
     `(q . k_int8) * s == q . (k_int8 * s)` up to float rounding, and
     the multiply shrinks from a [B, L, H, Dh] tensor to the
     [B, H, T, L] scores — 1/head_dim the work on the bandwidth-bound
-    read path.
+    read path. This placement is a CONTRACT, not an implementation
+    detail: the FT203 numerics auditor structurally verifies it
+    against this function's jaxpr (each scale applied exactly once, K
+    pre-softmax, V post-softmax), so a fused/Pallas rewrite that
+    double-, un- or wrong-side-scales fails `make analyze-numerics`.
     """
     batch, entries = table.shape
 

@@ -34,6 +34,9 @@ _LAZY_EXPORTS = {
     "per_device_bytes": "zero", "describe_state_sharding": "zero",
     "build_1f1b_schedule": "schedules", "schedule_stats": "schedules",
     "bubble_fraction": "schedules", "gpipe_bubble_fraction": "schedules",
+    # the numerics-audit program registry (analysis --numerics sweep);
+    # lazy so importing the package never builds demo programs
+    "numerics_audit_programs": "audit",
 }
 
 

@@ -1,0 +1,112 @@
+# The parallel layer's numerics-audit registry — the `parallel/` and
+# `models/` counterpart of `DecodeEngine.executables()`. The serve
+# engine already exposes every compiled executable by name for the
+# FT103 signature audit; training's hot programs (the wrapped
+# grad-accumulation + zero1 step, the 1F1B pipeline) had no such hook,
+# so the numerics sweep would have had to re-invent each program
+# inline and drift from the real call sites. Entries here are plain
+# dicts (label, fn, example_args, protect_outputs, ...) — deliberately
+# NOT analysis types, so this module never imports the analyzer and
+# the dependency only points analysis -> parallel. Programs are
+# shrunken but faithful: the audited facts (accumulator dtypes, cast
+# paths, key folding) are shape-class properties, not scale
+# properties.
+"""Numerics-audit program registry for the parallel layer."""
+import typing as tp
+
+__all__ = ["numerics_audit_programs"]
+
+
+def numerics_audit_programs() -> tp.List[tp.Dict[str, tp.Any]]:
+    """NumericsProgram kwargs for the training-side hot programs:
+    the `zero_update(with_grad_accumulation(...))` composed step
+    (labels `train/...`) and the 1F1B pipeline train step (labels
+    `pipeline/...`). Requires a multi-device backend (the analyze
+    sweeps run under 8 virtual CPU devices)."""
+    return _train_entries() + _pipeline_entries()
+
+
+def _train_entries() -> tp.List[tp.Dict[str, tp.Any]]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from .data_parallel import with_grad_accumulation
+    from .mesh import make_mesh
+    from .zero import zero_update
+
+    n = len(jax.devices())
+    dim, out, batch, micro = 16, 4, 8, 4
+    mesh = make_mesh({"data": n})
+    init_key = jax.random.PRNGKey(0)
+    params = {"w1": jax.random.normal(init_key, (dim, dim), jnp.float32),
+              "w2": jax.random.normal(init_key, (dim, out), jnp.float32)}
+
+    def loss_fn(p, batch_xy, key):
+        x, y = batch_xy
+        h = jnp.tanh(x @ p["w1"])
+        # dropout-style randomness: the microbatch fold_rng contract is
+        # part of the audited program, not a test-only decoration
+        keep = jax.random.bernoulli(key, 0.9, h.shape)
+        h = jnp.where(keep, h / 0.9, 0.0)
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    optim = optax.adamw(1e-3)
+    state = {"params": params, "opt_state": optim.init(params)}
+    step = zero_update(
+        with_grad_accumulation(jax.value_and_grad(loss_fn), micro),
+        optim, mesh=mesh, min_size=dim)
+    rng = np.random.default_rng(0)
+    batch_xy = (jnp.asarray(rng.standard_normal((batch, dim)), jnp.float32),
+                jnp.asarray(rng.standard_normal((batch, out)), jnp.float32))
+    key = jax.random.key(0)
+    return [{
+        "label": "train/accum-zero1-step",
+        "fn": step,
+        "example_args": (state, batch_xy, key),
+        # FT202: nothing may narrow on the way into the adam moments
+        # or the returned loss
+        "protect_outputs": ("opt_state", "loss"),
+    }]
+
+
+def _pipeline_entries() -> tp.List[tp.Dict[str, tp.Any]]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .mesh import make_mesh
+    from .pipeline import pipeline_1f1b
+
+    n = len(jax.devices())
+    pipe = 4 if n % 4 == 0 else 2
+    mesh = make_mesh({"pipe": pipe, "data": -1})
+    S, M, dim, batch = pipe, 2 * pipe, 8, 2 * pipe
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (S, dim, dim),
+                                     jnp.float32)}
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    def loss_fn(lp, h, tgt):
+        del lp
+        return jnp.mean((h - tgt) ** 2)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, dim)), jnp.float32)
+    tgt = jnp.zeros((batch, dim), jnp.float32)
+
+    def fn(p, xx, tg):
+        return pipeline_1f1b(stage_fn, p, xx, loss_fn=loss_fn,
+                             loss_params={}, targets=tg, mesh=mesh,
+                             num_microbatches=M, packed=False,
+                             overlap=False)
+
+    return [{
+        "label": "pipeline/1f1b-train",
+        "fn": fn,
+        "example_args": (params, x, tgt),
+        # the 1F1B output is (loss, grads): grads feed the optimizer
+        "protect_outputs": ("[1]",),
+    }]

@@ -670,7 +670,13 @@ class DecodeEngine:
         by compile-cache name. `compile_cache.signatures[name]` holds
         each one's recorded abstract call signatures — what the FT103
         trace auditor checks for retrace risk, and what `warmup()`
-        plus a clean `compile_cache.recompiles()` proves covered."""
+        plus a clean `compile_cache.recompiles()` proves covered.
+        This hook's pattern extends across the repo as the numerics
+        audit registries (`parallel.audit` / `models.audit` /
+        `datapipe.audit`, the FT2xx sweep): `models.audit` re-spells
+        this engine's verify and paged-attention contracts as traceable
+        programs, since compiled closures here carry no example args to
+        re-trace from."""
         return self.compile_cache.executables()
 
     def pool_stats(self) -> tp.Optional[tp.Dict[str, float]]:

@@ -1,0 +1,59 @@
+# The resurrected pre-PR-4 grad-accumulation bug, shape-faithful: the
+# running sums are built with jnp.zeros_like(grads) — the gradients'
+# OWN dtype — so a bf16 model accumulates microbatch gradients in
+# bf16. Each addend loses its low mantissa bits against the growing
+# partial sum; past ~8 microbatches the accumulated gradient visibly
+# drifts from the full-batch one. FT201 must flag every bf16 carry.
+"""Seeded FT201 violation: bf16 gradient accumulator (PR-4 bug #1)."""
+import jax
+import jax.numpy as jnp
+
+MICRO = 8
+
+EXPECT = {
+    "fixtures/ft201-bf16-accum": {("FT201", "narrow-accum:")},
+}
+
+
+def _value_and_grad(params, microbatch):
+    def loss(p):
+        h = jnp.tanh(microbatch @ p["w1"]) @ p["w2"]
+        return jnp.mean(h ** 2)
+
+    return jax.value_and_grad(loss)(params)
+
+
+def broken_accumulation_step(params, batch):
+    """`with_grad_accumulation` as originally shipped (pre PR 4)."""
+    micro = batch.reshape(MICRO, batch.shape[0] // MICRO, batch.shape[1])
+    loss_struct, grad_struct = jax.eval_shape(_value_and_grad, params,
+                                              micro[0])
+
+    def body(carry, microbatch):
+        loss_acc, grad_acc = carry
+        loss, grads = _value_and_grad(params, microbatch)
+        grad_acc = jax.tree_util.tree_map(lambda a, g: a + g,
+                                          grad_acc, grads)
+        return (loss_acc + loss, grad_acc), None
+
+    # THE BUG: zeros in the gradients' own dtype — bf16 in, bf16 summed
+    zeros = jax.tree_util.tree_map(
+        lambda g: jnp.zeros(g.shape, g.dtype), grad_struct)
+    (loss, grads), _ = jax.lax.scan(
+        body, (jnp.zeros(loss_struct.shape, loss_struct.dtype), zeros),
+        micro)
+    scale = 1.0 / MICRO
+    return loss * scale, jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+def programs():
+    dim, out = 16, 4
+    key = jax.random.PRNGKey(0)
+    params = {"w1": jax.random.normal(key, (dim, dim), jnp.bfloat16),
+              "w2": jax.random.normal(key, (dim, out), jnp.bfloat16)}
+    batch = jax.random.normal(key, (MICRO * 2, dim), jnp.bfloat16)
+    return [{
+        "label": "fixtures/ft201-bf16-accum",
+        "fn": broken_accumulation_step,
+        "example_args": (params, batch),
+    }]

@@ -1,0 +1,69 @@
+# The resurrected post-PR-4 complex bug, shape-faithful: the bf16 fix
+# built the running sums in f32 UNCONDITIONALLY and `astype`'d each
+# microbatch gradient into them — for a complex model that cast
+# silently discards every imaginary part, so the accumulated gradient
+# is the real projection of the true one and complex training walks a
+# wrong descent direction with no error, no warning a user sees, and
+# a perfectly plausible loss curve. FT201 must flag the complex->real
+# convert.
+"""Seeded FT201 violation: complex-dropping f32 accumulator (PR-4 #2)."""
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+MICRO = 4
+
+EXPECT = {
+    "fixtures/ft201-complex-drop": {("FT201", "complex-narrowing:")},
+}
+
+
+def _value_and_grad(params, microbatch):
+    def loss(p):
+        h = microbatch @ p["w"]
+        return jnp.mean(jnp.abs(h) ** 2)
+
+    # holomorphic grads of a complex parameter are complex
+    return loss(params), jax.grad(loss)(params)
+
+
+def broken_f32_fix_step(params, batch):
+    """The first f32 fix as originally shipped: f32 zeros, astype in."""
+    micro = batch.reshape(MICRO, batch.shape[0] // MICRO, batch.shape[1])
+    _, grad_struct = jax.eval_shape(_value_and_grad, params, micro[0])
+
+    def body(carry, microbatch):
+        loss_acc, grad_acc = carry
+        loss, grads = _value_and_grad(params, microbatch)
+        # THE BUG: g.astype(acc.dtype) with an unconditionally-f32
+        # accumulator — complex64 -> float32 drops the imaginary part
+        grad_acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), grad_acc, grads)
+        return (loss_acc + loss, grad_acc), None
+
+    zeros = jax.tree_util.tree_map(
+        lambda g: jnp.zeros(g.shape, jnp.float32), grad_struct)
+    with warnings.catch_warnings():
+        # jax warns once about the discarded imaginary part at trace
+        # time — exactly the warning nobody saw in PR 4
+        warnings.simplefilter("ignore")
+        (loss, grads), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros), micro)
+    scale = 1.0 / MICRO
+    return loss * scale, jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+def programs():
+    dim, out = 8, 4
+    key = jax.random.PRNGKey(0)
+    real = jax.random.normal(key, (dim, out), jnp.float32)
+    imag = jax.random.normal(jax.random.PRNGKey(1), (dim, out), jnp.float32)
+    params = {"w": (real + 1j * imag).astype(jnp.complex64)}
+    batch = jax.random.normal(key, (MICRO * 2, dim),
+                              jnp.float32).astype(jnp.complex64)
+    return [{
+        "label": "fixtures/ft201-complex-drop",
+        "fn": broken_f32_fix_step,
+        "example_args": (params, batch),
+    }]

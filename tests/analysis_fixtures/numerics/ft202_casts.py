@@ -1,0 +1,51 @@
+# Seeded FT202 violations: (1) a precision round trip — gradients
+# pass through bf16 and come back "f32" with a truncated mantissa no
+# dtype check can see again; (2) a narrowing cast on the path into
+# optimizer state — the Adam-moment-in-bf16 shape that biases every
+# small update toward zero.
+"""Seeded FT202 violations: dtype round trip, downcast into state."""
+import jax
+import jax.numpy as jnp
+
+EXPECT = {
+    "fixtures/ft202-roundtrip": {("FT202", "dtype-roundtrip:")},
+    "fixtures/ft202-downcast": {("FT202", "downcast-into-state:")},
+}
+
+
+def roundtrip_step(params, batch):
+    """A 'bandwidth optimization' that ships grads through bf16."""
+    loss, grads = jax.value_and_grad(
+        lambda p: jnp.mean((batch @ p) ** 2))(params)
+    # THE BUG: the wire format truncates, the widen-back hides it
+    wire = grads.astype(jnp.bfloat16)
+    grads = wire.astype(jnp.float32)
+    return params - 1e-3 * grads, {"loss": loss}
+
+
+def downcast_step(state, batch):
+    """An HBM 'saving' that keeps the Adam moment in bf16."""
+    loss, grads = jax.value_and_grad(
+        lambda p: jnp.mean((batch @ p) ** 2))(state["params"])
+    # THE BUG: the moment update narrows before the store
+    mu = state["opt_state"]["mu"] * 0.9 \
+        + grads.astype(jnp.bfloat16) * 0.1
+    params = state["params"] - 1e-3 * mu.astype(jnp.float32)
+    return {"params": params, "opt_state": {"mu": mu}}, {"loss": loss}
+
+
+def programs():
+    key = jax.random.PRNGKey(0)
+    params = jax.random.normal(key, (8, 4), jnp.float32)
+    batch = jax.random.normal(key, (4, 8), jnp.float32)
+    state = {"params": params,
+             "opt_state": {"mu": jnp.zeros((8, 4), jnp.float32)}}
+    return [
+        {"label": "fixtures/ft202-roundtrip",
+         "fn": roundtrip_step,
+         "example_args": (params, batch)},
+        {"label": "fixtures/ft202-downcast",
+         "fn": downcast_step,
+         "example_args": (state, batch),
+         "protect_outputs": ("opt_state",)},
+    ]

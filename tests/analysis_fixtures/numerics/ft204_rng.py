@@ -1,0 +1,65 @@
+# Seeded FT204 violations. Device side: the same PRNG key sampled
+# twice (identical "noise" on both draws) and a key sampled inside a
+# scan it never folded the index into (the repeated-dropout-mask bug
+# `with_grad_accumulation(fold_rng=True)` exists to prevent — every
+# microbatch sees the SAME mask). Host side: a seed derivation that
+# consults the global RNG (resume replays different randomness) and
+# one that ignores the draw counter k (every draw replays the same
+# randomness) — both break the datapipe's bit-identical-resume proof.
+"""Seeded FT204 violations: key reuse, impure host seed derivations."""
+import random
+
+import jax
+import jax.numpy as jnp
+
+EXPECT = {
+    "fixtures/ft204-key-reuse": {("FT204", "key-reuse:")},
+    "fixtures/ft204-loop-reuse": {("FT204", "key-reuse-in-loop:")},
+    "fixtures/ft204-host-seeds": {("FT204", "impure-seed:global-rng"),
+                                  ("FT204",
+                                   "k-insensitive-seed:ignores-k")},
+}
+
+
+def double_sample(x, key):
+    # THE BUG: both 'independent' noises are the same bits
+    noise_a = jax.random.normal(key, x.shape)
+    noise_b = jax.random.normal(key, x.shape)
+    return x + noise_a - noise_b  # "regularization" that is exactly 0
+
+
+def loop_sample(xs, key):
+    def body(carry, x):
+        # THE BUG: the unfolded key redraws the SAME mask every
+        # iteration — dropout that never varies across microbatches
+        keep = jax.random.bernoulli(key, 0.9, x.shape)
+        return carry + jnp.where(keep, x, 0.0), None
+
+    out, _ = jax.lax.scan(body, jnp.zeros(xs.shape[1:]), xs)
+    return out
+
+
+def _impure_seed(seed, k):
+    # THE BUG: global RNG state — two calls with the same (seed, k)
+    # disagree, so a resumed stream replays different draws
+    return random.randint(0, 2 ** 31 - 1)
+
+
+def _k_insensitive_seed(seed, k):
+    # THE BUG: k never enters — every draw gets the same derived seed
+    return (seed * 2654435761) % (2 ** 31)
+
+
+def programs():
+    key = jax.random.key(0)
+    return [
+        {"label": "fixtures/ft204-key-reuse",
+         "fn": double_sample,
+         "example_args": (jnp.ones((4,)), key)},
+        {"label": "fixtures/ft204-loop-reuse",
+         "fn": loop_sample,
+         "example_args": (jnp.ones((3, 4)), key)},
+        {"label": "fixtures/ft204-host-seeds",
+         "seed_fns": {"global-rng": _impure_seed,
+                      "ignores-k": _k_insensitive_seed}},
+    ]

@@ -138,3 +138,75 @@ def test_keep_embed_dense_escape_hatch():
     full_q = quantize_lm_params(params)
     assert cos_to_ref(qp) >= cos_to_ref(full_q) - 1e-9
     assert cos_to_ref(qp) > 0.999
+
+
+def test_quantize_kv_zero_rows_well_conditioned():
+    # FT203's runtime complement: an all-zero K/V row (the paged pool's
+    # sentinel block, a zero-init cache) must NOT produce an inf/NaN or
+    # pathologically-tiny scale. Before the clamp, the zero-absmax
+    # denominator only "worked" because sentinel rows sit past every
+    # causal horizon; the contract now is (q=0, scale=1) exactly.
+    from flashy_tpu.models.quantize import dequantize_kv, quantize_kv
+
+    x = jnp.zeros((2, 3, 8), jnp.float32)
+    q, scale = quantize_kv(x)
+    assert np.all(np.isfinite(np.asarray(scale)))
+    assert np.array_equal(np.asarray(scale), np.ones((2, 3), np.float32))
+    assert np.array_equal(np.asarray(q), np.zeros((2, 3, 8), np.int8))
+    assert np.array_equal(np.asarray(dequantize_kv(q, scale)),
+                          np.zeros((2, 3, 8), np.float32))
+    # the reciprocal path a fused kernel might take stays finite even
+    # in bf16 — the failure mode the old ~8e-15 epsilon scale invited
+    inv = 1.0 / jnp.asarray(scale, jnp.bfloat16)
+    assert np.all(np.isfinite(np.asarray(inv, np.float32)))
+    # mixed rows: zero rows get the unit scale, live rows keep absmax
+    mixed = jnp.concatenate([jnp.zeros((1, 8)), jnp.full((1, 8), 0.5)])
+    q2, scale2 = quantize_kv(mixed)
+    assert np.asarray(scale2)[0] == 1.0
+    assert np.isclose(np.asarray(scale2)[1], 0.5 / 127.0)
+    assert np.allclose(np.asarray(dequantize_kv(q2, scale2))[1], 0.5,
+                       rtol=1 / 127)
+
+
+def test_quantize_weights_zero_channel_well_conditioned():
+    # same clamp on the weights path: a dead output channel quantizes
+    # to (q=0, scale=1) and round-trips to exact zeros
+    from flashy_tpu.models.quantize import _quantize, dequantize
+
+    w = jnp.concatenate([jnp.zeros((8, 1)), jnp.ones((8, 1))], axis=1)
+    leaf = _quantize(w, contract_axes=(0,))
+    scale = np.asarray(leaf["scale"])
+    assert np.all(np.isfinite(scale))
+    assert scale[0, 0] == 1.0
+    back = np.asarray(dequantize(leaf))
+    assert np.array_equal(back[:, 0], np.zeros(8, np.float32))
+    assert np.allclose(back[:, 1], 1.0, rtol=1 / 127)
+
+
+def test_paged_attention_finite_over_all_zero_pool():
+    # end to end: attending a freshly-zeroed int8 pool (every gathered
+    # row is a sentinel-style zero row) must produce finite outputs —
+    # the inf/NaN scales this guards against would poison the softmax
+    # even though masked positions contribute no weight
+    from flashy_tpu.ops.paged_attention import (init_pool, paged_attention,
+                                                paged_write)
+
+    cfg = TransformerConfig(vocab_size=32, dim=16, num_layers=1,
+                            num_heads=2, attention="dense",
+                            max_seq_len=32, dtype=jnp.float32)
+    pool = init_pool(cfg, num_blocks=4, block_size=4, kv_dtype="int8")
+    entry = pool["block_0"]
+    table = jnp.asarray([[1, 2, 0]], jnp.int32)
+    positions = jnp.asarray([[0]], jnp.int32)
+    new = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 2, 8),
+                            jnp.float32)
+    entry = paged_write(entry, new, new, table, positions)
+    # an ALL-ZERO row written through the quantize-on-write path (a
+    # padded/parked slot's row) must land with the unit scale
+    zero_row = jnp.zeros((1, 1, 2, 8), jnp.float32)
+    entry = paged_write(entry, zero_row, zero_row, table,
+                        jnp.asarray([[1]], jnp.int32))
+    assert np.asarray(entry["k_scale"])[1, 1].min() == 1.0
+    out = paged_attention(new, entry, table, positions, head_dim=8,
+                          dtype=jnp.float32)
+    assert np.all(np.isfinite(np.asarray(out)))
