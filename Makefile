@@ -29,12 +29,14 @@ analyze:
 	fi
 
 # Trace-level program audit (flashy_tpu.analysis.trace): build the
-# zero/pipeline/serve demo programs on 8 virtual CPU devices and run
-# the FT101-FT104 auditors — compiled sharding layouts + collective
-# mix (FT101), pipeline tick tables model-checked against the traced
-# ppermute ring (FT102), jit-signature retrace risk (FT103), and
-# FLOP-priced idle-lane accounting (FT104). Exit 1 on any NEW finding
-# vs the committed .analysis-trace-baseline.json.
+# zero/pipeline/serve/elastic demo programs on 8 virtual CPU devices
+# and run the FT101-FT104 auditors — compiled sharding layouts +
+# collective mix (FT101, incl. the elastic leg: a zero1 checkpoint
+# restored onto a half-size mesh must stay genuinely sharded, not fall
+# back to silent full replication), pipeline tick tables model-checked
+# against the traced ppermute ring (FT102), jit-signature retrace risk
+# (FT103), and FLOP-priced idle-lane accounting (FT104). Exit 1 on any
+# NEW finding vs the committed .analysis-trace-baseline.json.
 analyze-trace:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m flashy_tpu.analysis --trace
@@ -135,6 +137,21 @@ pipeline-demo:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -W "ignore::RuntimeWarning:runpy" -m flashy_tpu.parallel.pipeline --steps 3
 
+# Elastic world-size drill on 8 virtual CPU devices: train at world 8,
+# take a simulated SIGTERM mid-epoch, resume at world 4 (a lost slice)
+# and grow back to 8 — with transient faults injected into the
+# checkpoint reshard (ckpt.reshard) and the datapipe cursor re-split
+# (datapipe.resplit), both of which must fire and be absorbed (strict
+# injector). Exit 1 unless params are allclose across every
+# save->restore transition, the consumed-token stream (canonical global
+# order) is bit-identical to an uninterrupted run, restored optimizer
+# state is genuinely sharded on the new mesh, and zero post-warm-up
+# recompiles happen in any phase. Seconds; also run by the tests
+# workflow.
+elastic-demo:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m flashy_tpu.resilience --elastic
+
 # Streaming-datapipe drill on CPU: pack a synthetic jsonl+npy corpus
 # mixture into fixed [B, L] segment-masked batches, train a tiny LM,
 # kill it with a simulated SIGTERM mid-stream, resume from the
@@ -156,4 +173,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo zero-demo pipeline-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo elastic-demo zero-demo pipeline-demo datapipe-demo docs native dist

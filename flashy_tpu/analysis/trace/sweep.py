@@ -14,7 +14,7 @@ from .core import AuditProgram
 
 __all__ = ["demo_programs", "SWEEP_LEGS"]
 
-SWEEP_LEGS = ("zero", "pipeline", "serve")
+SWEEP_LEGS = ("zero", "pipeline", "serve", "elastic")
 
 
 def _require_devices(minimum: int) -> None:
@@ -220,11 +220,67 @@ def _serve_programs() -> tp.List[AuditProgram]:
     return programs
 
 
+def _elastic_programs() -> tp.List[AuditProgram]:
+    """Elastic resume audited for silent full-replication fallback: a
+    zero1-sharded state saved on the full mesh is restored TOPOLOGY-FREE
+    onto a half-size mesh (`load_state_sharded(dir, mesh=...)`, the
+    restore-time reshard path). The failure mode this guards is the
+    reshard that "works" by gathering every leaf to every chip — the
+    restored state stays numerically right while the 1/N-per-chip claim
+    silently dies, which is exactly what FT101's live per-device-bytes
+    check catches (the restored leaves on the SMALLER mesh must still
+    hold ~1/(N/2) each)."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ...checkpoint import load_state_sharded, save_state_sharded
+    from ...parallel.mesh import make_mesh
+    from ...parallel.zero import zero_sharding
+
+    _require_devices(4)
+    n = len(jax.devices())
+    half = n // 2
+    dim = 8 * n  # divisible by both mesh sizes
+    mesh_full = make_mesh({"data": n})
+    key = jax.random.PRNGKey(0)
+    params = {"w1": jax.random.normal(key, (dim, dim), jnp.float32),
+              "w2": jax.random.normal(key, (dim, 8), jnp.float32)}
+    optim = optax.adam(1e-3)
+    state = {"params": params, "opt_state": optim.init(params)}
+    state = jax.device_put(
+        state, zero_sharding(state, mesh_full, min_size=dim))
+
+    workdir = tempfile.mkdtemp(prefix="flashy_elastic_audit_")
+    try:
+        save_state_sharded(state, Path(workdir) / "ckpt.sharded")
+        mesh_half = make_mesh({"data": half}, devices=jax.devices()[:half])
+        restored = load_state_sharded(Path(workdir) / "ckpt.sharded",
+                                      mesh=mesh_half)
+        # params are replicated by design; drop them so the audited
+        # subtree is exactly the sharded-by-promise optimizer state
+        del restored["params"]
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return [AuditProgram(
+        label=f"elastic/restore-{half}of{n}",
+        state=restored,
+        expect_sharded=("opt_state",),
+        # the restored moments live on `half` chips: anything above
+        # 1/half + slack means the reshard fell back to replication
+        sharded_bytes_ratio=1.0 / half + 0.25,
+    )]
+
+
 def demo_programs(legs: tp.Sequence[str] = SWEEP_LEGS
                   ) -> tp.List[AuditProgram]:
     """Build the audit programs for the requested demo legs."""
     builders = {"zero": _zero_programs, "pipeline": _pipeline_programs,
-                "serve": _serve_programs}
+                "serve": _serve_programs, "elastic": _elastic_programs}
     unknown = [leg for leg in legs if leg not in builders]
     if unknown:
         raise ValueError(f"unknown sweep leg(s) {unknown}; "

@@ -14,6 +14,7 @@
 """Checkpoint IO: single-file, sharded (Orbax), and torch interop."""
 from pathlib import Path
 import logging
+import math
 import pickle
 import typing as tp
 
@@ -159,6 +160,213 @@ def _extract_device_arrays(state: tp.Any):
 
 _POINTER = "CURRENT"
 _SLOTS = ("slot0", "slot1")
+# Topology metadata written into every committed slot (and mirrored into
+# the solver's checkpoint_meta.json): the mesh the state was saved on
+# plus each array leaf's LOGICAL sharding spec. It exists so restore can
+# treat sharding as a restore-time choice — `load_state_sharded(dir,
+# mesh=target)` rebuilds placements on an ARBITRARY target mesh from the
+# saved specs, instead of requiring the saving topology back.
+TOPOLOGY_NAME = "topology.json"
+
+
+def _spec_to_json(spec: tp.Any) -> tp.Optional[tp.List[tp.Any]]:
+    """A PartitionSpec as JSON: axis name, list of names, or null per dim."""
+    if spec is None:
+        return None
+    return [list(part) if isinstance(part, tuple) else part for part in spec]
+
+
+def describe_topology(state: tp.Any) -> tp.Dict[str, tp.Any]:
+    """The save-time topology record of a state pytree.
+
+    Returns ``{"device_count", "world_size", "mesh": {"axis_names",
+    "shape"} | None, "state_sharding", "leaves": {key: {"shape",
+    "dtype", "spec"}}}`` where `key` matches the Orbax array-store keys
+    (`jax.tree_util.keystr`) and `spec` is the leaf's logical
+    PartitionSpec (null when replicated / unsharded). `device_count` is
+    the number of chips of the mesh the state actually lives on (the
+    "world size" of the accelerator fleet, which in elastic resume is
+    the quantity that churns); `world_size` is the host process count.
+    """
+    leaves: tp.Dict[str, tp.Dict[str, tp.Any]] = {}
+    mesh_info: tp.Optional[tp.Dict[str, tp.Any]] = None
+    device_ids: tp.Set[int] = set()
+
+    def visit(path, leaf):
+        nonlocal mesh_info
+        if not isinstance(leaf, (jax.Array, jax.ShapeDtypeStruct)):
+            return leaf
+        sharding = getattr(leaf, "sharding", None)
+        entry: tp.Dict[str, tp.Any] = {
+            "shape": [int(s) for s in leaf.shape],
+            "dtype": str(np.dtype(leaf.dtype)),
+            "spec": _spec_to_json(getattr(sharding, "spec", None)),
+        }
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is not None and hasattr(mesh, "axis_names"):
+            info = {"axis_names": list(mesh.axis_names),
+                    "shape": [int(mesh.shape[name])
+                              for name in mesh.axis_names]}
+            # one mesh per state is the framework convention; if several
+            # appear, keep the largest (the one resharding must honor)
+            if mesh_info is None or (math.prod(info["shape"])
+                                     > math.prod(mesh_info["shape"])):
+                mesh_info = info
+        device_set = getattr(sharding, "device_set", None)
+        if device_set:
+            device_ids.update(d.id for d in device_set)
+        leaves[jax.tree_util.keystr(path)] = entry
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, state)
+    if mesh_info is not None:
+        device_count = math.prod(mesh_info["shape"])
+    elif device_ids:
+        device_count = len(device_ids)
+    else:
+        device_count = jax.device_count()
+    record: tp.Dict[str, tp.Any] = {
+        "version": 1,
+        "device_count": device_count,
+        "world_size": jax.process_count(),
+        "mesh": mesh_info,
+        "leaves": leaves,
+    }
+    try:
+        from .parallel.zero import describe_state_sharding
+        record["state_sharding"] = describe_state_sharding(state)["summary"]
+    except Exception:  # classification is advisory, never load-bearing
+        record["state_sharding"] = None
+    return record
+
+
+def format_topology(topology: tp.Optional[tp.Mapping[str, tp.Any]]) -> str:
+    """One-line human summary of a `describe_topology` record."""
+    if not topology:
+        return "unknown (no topology metadata)"
+    parts = [f"{topology.get('device_count', '?')} device(s)"]
+    mesh = topology.get("mesh")
+    if mesh:
+        axes = ",".join(f"{name}={size}" for name, size
+                        in zip(mesh["axis_names"], mesh["shape"])
+                        if int(size) != 1) or "1-chip"
+        parts.append(f"mesh({axes})")
+    if topology.get("state_sharding"):
+        parts.append(f"state={topology['state_sharding']}")
+    if topology.get("world_size", 1) != 1:
+        parts.append(f"{topology['world_size']} host(s)")
+    return " ".join(parts)
+
+
+def topology_differs(saved: tp.Optional[tp.Mapping[str, tp.Any]],
+                     live: tp.Optional[tp.Mapping[str, tp.Any]]) -> bool:
+    """True when two topology records describe different fleets: the
+    device count differs, or — same count — the mesh axis names/shape
+    do (losing a slice AND re-axing the survivors is still churn).
+    Missing records compare equal: no metadata means no verdict."""
+    if not saved or not live:
+        return False
+    a, b = saved.get("device_count"), live.get("device_count")
+    if a is not None and b is not None and int(a) != int(b):
+        return True
+    mesh_a, mesh_b = saved.get("mesh"), live.get("mesh")
+    if mesh_a and mesh_b:
+        if list(mesh_a.get("axis_names", ())) != list(
+                mesh_b.get("axis_names", ())):
+            return True
+        if [int(s) for s in mesh_a.get("shape", ())] != [
+                int(s) for s in mesh_b.get("shape", ())]:
+            return True
+    return False
+
+
+def load_saved_topology(sharded_directory: AnyPath,
+                        meta_path: AnyPath) -> tp.Optional[tp.Dict]:
+    """The topology a checkpoint was saved on, from either source: the
+    sharded slot's hash-verified `topology.json` when one exists, else
+    the `checkpoint_meta.json` mirror (covers single-file checkpoints).
+    None when neither does — a pre-elastic checkpoint. The one shared
+    lookup behind `BaseSolver.restore` and `python -m flashy_tpu.info
+    --verify-checkpoint`."""
+    import json
+    sharded_directory = Path(sharded_directory)
+    if sharded_directory.is_dir():
+        topology = load_topology(sharded_directory)
+        if topology is not None:
+            return topology
+    meta_path = Path(meta_path)
+    if meta_path.exists():
+        try:
+            with open(meta_path) as f:
+                return json.load(f).get("topology")
+        except (json.JSONDecodeError, OSError):
+            return None
+    return None
+
+
+def load_topology(directory: AnyPath,
+                  slot: tp.Optional[str] = None) -> tp.Optional[tp.Dict]:
+    """Read the topology record of a committed sharded checkpoint (the
+    active slot by default). None when the checkpoint predates topology
+    metadata or does not exist."""
+    import json
+    directory = Path(directory)
+    slot = slot or _read_slot_pointer(directory)
+    if slot is None:
+        return None
+    path = directory / slot / TOPOLOGY_NAME
+    if not path.exists():
+        return None
+    try:
+        return json.loads(path.read_text())
+    except (json.JSONDecodeError, OSError):
+        logger.warning("unreadable %s in slot %r of %s", TOPOLOGY_NAME,
+                       slot, directory)
+        return None
+
+
+def reshard_placements(topology: tp.Mapping[str, tp.Any],
+                       mesh: tp.Any) -> tp.Dict[str, tp.Any]:
+    """Build per-leaf placements on a TARGET mesh from saved topology.
+
+    Each saved leaf's logical spec is re-applied onto `mesh`: axes the
+    target mesh still has keep sharding that dim (when the dim stays
+    divisible by the new axis size); axes the target lost — or dims no
+    longer divisible — fall back to replicated for that dim with a
+    WARN. Returns `{leaf_key: ShapeDtypeStruct(..., sharding=...)}`,
+    the `placements` shape `load_state_sharded` consumes — this is what
+    makes an N-chip checkpoint restorable on an M-chip mesh.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+    axis_sizes = {name: int(mesh.shape[name]) for name in mesh.axis_names}
+    placements: tp.Dict[str, tp.Any] = {}
+    for key, entry in (topology.get("leaves") or {}).items():
+        shape = tuple(int(s) for s in entry.get("shape", ()))
+        spec = entry.get("spec")
+        parts: tp.List[tp.Any] = []
+        if spec is not None:
+            for dim, part in zip(shape, list(spec) + [None] * len(shape)):
+                if part is None:
+                    parts.append(None)
+                    continue
+                names = tuple(part) if isinstance(part, list) else (part,)
+                size = 1
+                known = all(name in axis_sizes for name in names)
+                if known:
+                    size = math.prod(axis_sizes[name] for name in names)
+                if not known or size < 1 or dim % size:
+                    logger.warning(
+                        "reshard: leaf %s dim %d (spec %r) cannot shard "
+                        "onto the target mesh %r — restoring that dim "
+                        "replicated", key, dim, part, dict(axis_sizes))
+                    parts.append(None)
+                else:
+                    parts.append(tuple(names) if len(names) > 1
+                                 else names[0])
+        sharding = NamedSharding(mesh, PartitionSpec(*parts))
+        placements[key] = jax.ShapeDtypeStruct(
+            shape, np.dtype(entry["dtype"]), sharding=sharding)
+    return placements
 
 
 def _read_slot_pointer(directory: Path) -> tp.Optional[str]:
@@ -192,8 +400,9 @@ def _prepare_slot(directory: Path) -> str:
         slot_dir.mkdir(parents=True, exist_ok=True)
         # both the commit marker and the manifest: an aborted write must
         # leave neither a "complete" look nor a stale integrity record
+        # (nor a stale topology describing a save that never landed)
         from .resilience.integrity import MANIFEST_NAME
-        for name in ("state.pkl", MANIFEST_NAME):
+        for name in ("state.pkl", MANIFEST_NAME, TOPOLOGY_NAME):
             stale = slot_dir / name
             if stale.exists():
                 stale.unlink()
@@ -202,19 +411,32 @@ def _prepare_slot(directory: Path) -> str:
 
 
 def _commit_slot(directory: Path, target: str, skeleton: tp.Any,
-                 on_commit: tp.Optional[tp.Callable[[], None]] = None) -> None:
+                 on_commit: tp.Optional[tp.Callable[[], None]] = None,
+                 topology: tp.Optional[tp.Dict[str, tp.Any]] = None) -> None:
     """Make slot `target` the active checkpoint: write the skeleton (the
-    commit marker), then the integrity manifest, then atomically flip
-    the CURRENT pointer. Collective: no rank returns before the flip is
-    visible (a rank racing ahead could read the OLD checkpoint as
-    current). The manifest is written AFTER the all-payload barrier (so
-    it covers every host's Orbax shards) and BEFORE the flip (so an
-    active slot always carries one). `on_commit` runs on every rank
-    after the flip — cleanup that must not precede durability."""
+    commit marker) and the topology record, then the integrity manifest,
+    then atomically flip the CURRENT pointer. Collective: no rank
+    returns before the flip is visible (a rank racing ahead could read
+    the OLD checkpoint as current). The manifest is written AFTER the
+    all-payload barrier (so it covers every host's Orbax shards AND the
+    topology record — restore's rank-0 integrity hashing therefore
+    verifies the topology too) and BEFORE the flip (so an active slot
+    always carries one). `on_commit` runs on every rank after the flip
+    — cleanup that must not precede durability."""
+    import json
+
     from . import distrib
     if distrib.is_rank_zero():
         _write_state_file(directory / target / "state.pkl", skeleton,
                           sidecar=False)
+        if topology is not None:
+            def write_topology() -> None:
+                with write_and_rename(directory / target / TOPOLOGY_NAME,
+                                      "w") as f:
+                    json.dump(topology, f, indent=2)
+
+            call_with_retry(write_topology, name="ckpt.topology",
+                            retry_on=(OSError,))
     distrib.barrier("flashy_tpu_ckpt_written")
     if distrib.is_rank_zero():
         def write_slot_manifest() -> None:
@@ -248,13 +470,14 @@ def save_state_sharded(state: tp.Any, directory: AnyPath) -> None:
     together; the filesystem must be shared across hosts (GCS/NFS).
     """
     directory = Path(directory).absolute()
+    topology = describe_topology(state)
     skeleton, arrays = _extract_device_arrays(state)
     target = _prepare_slot(directory)
     if arrays:
         import orbax.checkpoint as ocp
         with ocp.PyTreeCheckpointer() as checkpointer:
             checkpointer.save(directory / target / "arrays", arrays, force=True)
-    _commit_slot(directory, target, skeleton)
+    _commit_slot(directory, target, skeleton, topology=topology)
 
 
 class AsyncShardedCheckpointer:
@@ -272,7 +495,8 @@ class AsyncShardedCheckpointer:
 
     def __init__(self) -> None:
         self._checkpointer = None
-        self._pending: tp.Optional[tp.Tuple[Path, str, tp.Any, tp.Any]] = None
+        self._pending: tp.Optional[
+            tp.Tuple[Path, str, tp.Any, tp.Any, tp.Any]] = None
 
     def _orbax(self):
         if self._checkpointer is None:
@@ -288,12 +512,13 @@ class AsyncShardedCheckpointer:
         checkpoints there, never before."""
         self.finalize_pending()
         directory = Path(directory).absolute()
+        topology = describe_topology(state)
         skeleton, arrays = _extract_device_arrays(state)
         target = _prepare_slot(directory)
         if arrays:
             self._orbax().save(directory / target / "arrays", arrays,
                                force=True)
-        self._pending = (directory, target, skeleton, on_commit)
+        self._pending = (directory, target, skeleton, on_commit, topology)
 
     def finalize_pending(self) -> None:
         """Block until the in-flight save is durable, then commit it."""
@@ -301,9 +526,10 @@ class AsyncShardedCheckpointer:
             return
         if self._checkpointer is not None:
             self._checkpointer.wait_until_finished()
-        directory, target, skeleton, on_commit = self._pending
+        directory, target, skeleton, on_commit, topology = self._pending
         self._pending = None
-        _commit_slot(directory, target, skeleton, on_commit)
+        _commit_slot(directory, target, skeleton, on_commit,
+                     topology=topology)
 
     # `wait` reads naturally at call sites that just need durability.
     wait = finalize_pending
@@ -335,7 +561,44 @@ def _load_slot_skeleton(directory: Path, slot: str) -> tp.Any:
                             f"slot {slot!r} skeleton")
 
 
-def load_state_sharded(directory: AnyPath, placements: tp.Any = None) -> tp.Any:
+def _mesh_record(mesh: tp.Any) -> tp.Dict[str, tp.Any]:
+    return {"axis_names": list(mesh.axis_names),
+            "shape": [int(mesh.shape[name]) for name in mesh.axis_names]}
+
+
+def _target_topology(placement_by_key: tp.Mapping[str, tp.Any],
+                     mesh: tp.Any
+                     ) -> tp.Tuple[str, tp.Optional[tp.Dict[str, tp.Any]]]:
+    """(human description, topology record) of the restore TARGET —
+    from the explicit mesh when given, else from the placements'
+    shardings. Feeds `topology_differs` for elastic-resume detection
+    and the error messages that must name the two topologies instead
+    of leaking a raw Orbax/XLA error; None record = no placement info
+    (host restore, no verdict)."""
+    if mesh is not None:
+        record = {"device_count": int(mesh.size),
+                  "mesh": _mesh_record(mesh)}
+        return format_topology(record), record
+    device_ids: tp.Set[int] = set()
+    mesh_info = None
+    for target in placement_by_key.values():
+        sharding = getattr(target, "sharding", None)
+        if sharding is None:
+            continue
+        device_set = getattr(sharding, "device_set", None)
+        if device_set:
+            device_ids.update(d.id for d in device_set)
+        target_mesh = getattr(sharding, "mesh", None)
+        if mesh_info is None and hasattr(target_mesh, "axis_names"):
+            mesh_info = _mesh_record(target_mesh)
+    if device_ids:
+        record = {"device_count": len(device_ids), "mesh": mesh_info}
+        return format_topology(record), record
+    return f"{jax.device_count()} device(s) (no explicit placements)", None
+
+
+def load_state_sharded(directory: AnyPath, placements: tp.Any = None, *,
+                       mesh: tp.Any = None) -> tp.Any:
     """Restore a `save_state_sharded` checkpoint.
 
     `placements` is a pytree mirroring (a prefix of) the saved state whose
@@ -343,6 +606,17 @@ def load_state_sharded(directory: AnyPath, placements: tp.Any = None) -> tp.Any:
     restored by Orbax *directly onto their mesh placement* (each host
     reads only its shards). Leaves without a placement come back as host
     values. ALL processes must call this together.
+
+    Sharding is a RESTORE-TIME choice, not a save-time fact: the target
+    shardings need not match the topology the checkpoint was written on.
+    With `mesh=` given, leaves without an explicit placement are placed
+    by re-applying their SAVED logical spec (the slot's topology record,
+    hash-verified with the rest of the slot) onto the target mesh — an
+    N-chip checkpoint restores onto an M-chip mesh, and replicated /
+    zero1 / fsdp layout changes are expressed simply by passing
+    different placements. When the saved and target topologies differ,
+    the reshard is logged loudly and passes the ``ckpt.reshard`` fault
+    site; the Orbax shard reads are retried on transient IO failure.
 
     Each slot is verified against its integrity manifest before
     unpickling. When the ACTIVE slot is corrupt or unreadable, restore
@@ -420,6 +694,34 @@ def load_state_sharded(directory: AnyPath, placements: tp.Any = None) -> tp.Any:
 
         jax.tree_util.tree_map_with_path(note, placements)
 
+    # Elastic resume: the slot's topology record describes the mesh the
+    # checkpoint was WRITTEN on; the placements / `mesh` describe where
+    # it is restoring TO. A mismatch is not an error — it is the
+    # restore-time reshard this path exists for — but it must be loud,
+    # and with `mesh=` the saved logical specs fill in placements for
+    # every leaf the caller did not pin explicitly.
+    topology = load_topology(directory, slot)
+    target_desc, target_record = _target_topology(placement_by_key, mesh)
+    saved_devices = (topology or {}).get("device_count")
+    target_devices = (target_record or {}).get("device_count")
+    resharding = topology_differs(topology, target_record)
+    if mesh is not None:
+        if topology is None:
+            logger.warning(
+                "load_state_sharded(%s, mesh=...): the checkpoint carries "
+                "no topology record (saved before elastic checkpoints), so "
+                "the target mesh cannot place leaves without explicit "
+                "placements — they restore as host values.", directory)
+        else:
+            for key, placement in reshard_placements(topology, mesh).items():
+                placement_by_key.setdefault(key, placement)
+    if resharding:
+        logger.warning(
+            "RESHARDING AT RESTORE: checkpoint %s was saved on %s and is "
+            "restoring onto %s — sharding is a restore-time choice; the "
+            "state is re-placed from the slot's topology record.",
+            directory, format_topology(topology), target_desc)
+
     arrays: tp.Dict[str, tp.Any] = {}
     if slot_keys:
         import orbax.checkpoint as ocp
@@ -442,16 +744,31 @@ def load_state_sharded(directory: AnyPath, placements: tp.Any = None) -> tp.Any:
             else:
                 item[key] = 0
                 restore_args[key] = ocp.RestoreArgs()
-        try:
+
+        def restore_arrays() -> tp.Dict[str, tp.Any]:
+            # The retried unit is a read (idempotent, no collective);
+            # under an active reshard it is also the ckpt.reshard fault
+            # site, so elastic drills can prove a transient shard-read
+            # failure mid-reshard is absorbed.
+            if resharding:
+                chaos.fault_point("ckpt.reshard", slot=slot,
+                                  saved=saved_devices,
+                                  target=target_devices)
             with ocp.PyTreeCheckpointer() as checkpointer:
-                arrays = checkpointer.restore(directory / slot / "arrays",
-                                              item=item,
-                                              restore_args=restore_args)
+                return checkpointer.restore(directory / slot / "arrays",
+                                            item=item,
+                                            restore_args=restore_args)
+
+        try:
+            arrays = call_with_retry(restore_arrays, name="ckpt.reshard"
+                                     if resharding else "ckpt.load",
+                                     retry_on=(OSError,))
         except Exception as exc:
             raise CheckpointError(
                 f"Orbax array restore failed for slot {slot!r} under "
-                f"{directory / slot / 'arrays'}: "
-                f"{type(exc).__name__}: {exc}") from exc
+                f"{directory / slot / 'arrays'} (checkpoint saved on "
+                f"{format_topology(topology)}; restore target "
+                f"{target_desc}): {type(exc).__name__}: {exc}") from exc
 
     def fill(leaf):
         return arrays[leaf.key] if isinstance(leaf, ArraySlot) else leaf

@@ -8,6 +8,9 @@
 # flake8: noqa
 """flashy_tpu.datapipe: sharded streaming, packing, mixtures, exact resume."""
 from .audit import numerics_audit_programs
+from .elastic import (ElasticCursorGroup, resplit_mixture_states,
+                      resplit_packer_states, resplit_prefetch_states,
+                      resplit_states, resplit_stream_states)
 from .iterator import CheckpointableIterator, PipelineStage
 from .mixture import MixtureStream
 from .packing import SequencePacker

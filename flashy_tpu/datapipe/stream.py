@@ -10,12 +10,15 @@
 """ShardedTextStream: disjoint per-host file shards -> document stream."""
 from pathlib import Path
 import json
+import logging
 import typing as tp
 
 import numpy as np
 
 from ..utils import AnyPath
 from .iterator import PipelineStage
+
+logger = logging.getLogger(__name__)
 
 
 def _load_documents(path: Path) -> tp.List[np.ndarray]:
@@ -104,6 +107,12 @@ class ShardedTextStream(PipelineStage):
             raise ValueError("ShardedTextStream got an empty shard list; "
                              "an empty stream would starve this process and "
                              "deadlock any downstream collective.")
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+        # The GLOBAL sorted file list (pre-slice) is part of the cursor
+        # identity: a world-size re-split is only token-exact against
+        # the same global corpus, so resume validates it by name.
+        self._global_files = [f.name for f in files]
         self.files = files[shard_index::num_shards]
         if not self.files:
             raise ValueError(
@@ -142,27 +151,96 @@ class ShardedTextStream(PipelineStage):
         return {"cursors": list(self._cursors), "rr": self._rr,
                 "passes": self._passes,
                 "num_files": len(self.files),
-                "file_names": [f.name for f in self.files]}
+                "file_names": [f.name for f in self.files],
+                # v2 (elastic) fields: the per-file cursor map plus the
+                # global layout, so a checkpoint written under world
+                # size N can be re-partitioned to world size M
+                # (`datapipe.elastic.resplit_stream_states`).
+                "shard_index": self.shard_index,
+                "num_shards": self.num_shards,
+                "global_file_names": list(self._global_files),
+                "file_cursors": {f.name: int(c) for f, c
+                                 in zip(self.files, self._cursors)}}
 
     def load_state_dict(self, state: tp.Dict[str, tp.Any]) -> None:
-        if state["num_files"] != len(self.files):
+        names = [f.name for f in self.files]
+        if state["num_files"] == len(self.files) \
+                and state.get("file_names", names) == names:
+            # same layout: exact positional resume, as ever
+            self._cursors = list(state["cursors"])
+            self._rr = int(state["rr"])
+            self._passes = int(state["passes"])
+            return
+        if state.get("file_names", names) == names:
+            # a pre-elastic cursor (no file_names recorded) whose count
+            # does not match: positional cursors cannot be re-dealt.
             raise ValueError(
                 f"checkpointed cursor covers {state['num_files']} shard "
                 f"files but this process is assigned {len(self.files)}; "
                 "resuming with a different sharding layout cannot be "
                 "token-exact.")
+        # A DIFFERENT layout: the world-size-aware re-split path. Only
+        # sound when the state carries a per-file cursor map covering
+        # every file this process now owns (a re-split state built by
+        # `elastic.resplit_stream_states`, or a world-size-1 cursor
+        # being re-partitioned) against the SAME global corpus.
+        from ..resilience.retry import call_with_retry
+        call_with_retry(self._adopt_resplit, state, name="datapipe.resplit",
+                        retry_on=(OSError,))
+
+    def _adopt_resplit(self, state: tp.Dict[str, tp.Any]) -> None:
+        """Adopt per-file cursors from a cursor saved under a different
+        sharding layout (world size N -> this stream's M). Token-exact
+        by construction: every file resumes at its exact consumed-doc
+        prefix, so no document is read twice and none is skipped."""
+        from ..resilience import chaos
+        chaos.fault_point("datapipe.resplit", shard_index=self.shard_index,
+                          num_shards=self.num_shards)
         names = [f.name for f in self.files]
-        if state.get("file_names", names) != names:
-            # same COUNT but renamed/replaced/reordered shards: per-file
-            # cursors would land on the wrong files and silently skip or
-            # re-read documents.
+        saved_layout = (f"{state.get('num_files')} files of shard "
+                        f"{state.get('shard_index', '?')}/"
+                        f"{state.get('num_shards', '?')}")
+        live_layout = (f"{len(self.files)} files of shard "
+                       f"{self.shard_index}/{self.num_shards}")
+        cursors = state.get("file_cursors")
+        if cursors is None:
             raise ValueError(
-                "checkpointed cursor names different shard files "
-                f"({state['file_names']} vs {names}); resuming against a "
-                "changed file set cannot be token-exact.")
-        self._cursors = list(state["cursors"])
-        self._rr = int(state["rr"])
+                f"checkpointed cursor ({saved_layout}) does not match this "
+                f"process's layout ({live_layout}) and carries no per-file "
+                "cursor map — it predates elastic checkpoints; re-splitting "
+                "it cannot be token-exact.")
+        saved_global = state.get("global_file_names")
+        if saved_global is not None \
+                and list(saved_global) != list(self._global_files):
+            raise ValueError(
+                "checkpointed cursor names different shard files at the "
+                f"global level ({saved_global} vs {self._global_files}); "
+                "re-splitting against a changed file set cannot be "
+                "token-exact.")
+        missing = [name for name in names if name not in cursors]
+        if missing:
+            raise ValueError(
+                f"re-split cursor covers only {sorted(cursors)} but this "
+                f"process ({live_layout}) also owns {missing}; merge every "
+                "source rank's cursor first "
+                "(datapipe.elastic.resplit_stream_states).")
+        self._cursors = [int(cursors[name]) for name in names]
+        self._rr = min(range(len(self.files)),
+                       key=lambda i: (self._cursors[i], i))
         self._passes = int(state["passes"])
+        logger.warning(
+            "ELASTIC RE-SPLIT: shard cursor saved as %s re-partitioned "
+            "onto %s (global corpus of %d files unchanged); per-file "
+            "positions are exact.", saved_layout, live_layout,
+            len(self._global_files))
+        from ..observability import get_telemetry
+        telemetry = get_telemetry()
+        if telemetry is not None:
+            telemetry.record({
+                "type": "datapipe_resplit",
+                "saved_layout": saved_layout, "live_layout": live_layout,
+                "files": len(self.files),
+                "global_files": len(self._global_files)})
 
     def close(self) -> None:
         """No-op: the stream holds no OS resources (files are read

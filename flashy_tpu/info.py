@@ -129,12 +129,18 @@ def format_checkpoint_meta(meta: dict) -> str:
     return "  ".join(parts) or "(empty checkpoint_meta.json)"
 
 
-def format_verify_report(sig: str, report: dict) -> str:
+def format_verify_report(sig: str, report: dict,
+                         topology: dict = None,
+                         live_devices: int = None) -> str:
     """One-line view of a `resilience.verify_checkpoint` report.
 
     Shows every checkpoint form found under the XP (single file, A/B
-    slots with the active one marked) and whether at least one verified
-    restore source remains.
+    slots with the active one marked), whether at least one verified
+    restore source remains, and — when the checkpoint carries topology
+    metadata — the mesh it was SAVED on. When `live_devices` differs
+    from the saved device count, a WARN line flags that restoring here
+    will reshard (the elastic-resume path), instead of the mismatch
+    surfacing only at restore time.
     """
     parts = []
     if report["single"] is not None:
@@ -148,6 +154,15 @@ def format_verify_report(sig: str, report: dict) -> str:
         return f"{sig}  no checkpoints"
     verdict = "restorable" if report["restorable"] else "NOT RESTORABLE"
     line = f"{sig}  {' '.join(parts)}  -> {verdict}"
+    if topology:
+        from .checkpoint import format_topology
+        line += f"\n  topology: saved on {format_topology(topology)}"
+        saved_devices = topology.get("device_count")
+        if (live_devices is not None and saved_devices is not None
+                and int(saved_devices) != int(live_devices)):
+            line += (f"\n  WARN: live mesh has {live_devices} device(s) "
+                     f"but the checkpoint was saved on {saved_devices} — "
+                     "restore will reshard (elastic resume)")
     problems = list(report["single"] or [])
     for slot_problems in report["slots"].values():
         problems += slot_problems
@@ -168,16 +183,36 @@ def verify_checkpoints(root: Path) -> int:
     if not xps_dir.is_dir():
         print(f"no experiments under {root}/xps")
         return 1
+    live_devices = None
     bad = 0
     for folder in sorted(xps_dir.iterdir()):
         if not folder.is_dir():
             continue
         report = verify_checkpoint(folder)
-        print(format_verify_report(folder.name, report))
+        topology = _saved_topology(folder)
+        if topology is not None and live_devices is None:
+            # lazy: only initialize a JAX backend when some checkpoint
+            # actually carries topology metadata to compare against
+            try:
+                import jax
+                live_devices = jax.device_count()
+            except Exception:
+                live_devices = None
+        print(format_verify_report(folder.name, report, topology=topology,
+                                   live_devices=live_devices))
         has_any = report["single"] is not None or report["slots"]
         if has_any and not report["restorable"]:
             bad += 1
     return 1 if bad else 0
+
+
+def _saved_topology(folder: Path):
+    """The topology an XP's checkpoint was saved on (the shared
+    slot-then-meta lookup; None for pre-elastic checkpoints)."""
+    from .checkpoint import load_saved_topology
+    from .solver import CHECKPOINT_META_NAME
+    return load_saved_topology(folder / "checkpoint.fsy.sharded",
+                               folder / CHECKPOINT_META_NAME)
 
 
 def format_device_stats() -> str:

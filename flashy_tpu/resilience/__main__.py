@@ -194,15 +194,430 @@ def run_drill(epochs: int = 5, root: tp.Optional[str] = None,
     return 0
 
 
+# ---------------------------------------------------------------------------
+# The ELASTIC drill — `python -m flashy_tpu.resilience --elastic` / `make
+# elastic-demo`. The chaos drill above proves resume-exactness on a FIXED
+# topology; this one proves it across fleet churn: train on 8 virtual
+# devices, take a simulated SIGTERM mid-epoch, resume on 4 (a lost
+# slice), grow back to 8 — with a transient shard-read fault injected
+# into the reshard (`ckpt.reshard`) and the cursor re-partition
+# (`datapipe.resplit`) of every shrink/grow, both of which must fire and
+# be absorbed. Exit 1 unless params are allclose across every
+# save->restore transition, the concatenated consumed-token stream is
+# bit-identical (in the canonical global order) to an uninterrupted run,
+# restored optimizer state is ACTUALLY sharded on the new mesh (no
+# silent full-replication fallback), and zero post-warm-up recompiles
+# happen in any phase.
+# ---------------------------------------------------------------------------
+
+ELASTIC_FILES = 8       # global shard files == global docs per step
+ELASTIC_DOC_LEN = 16
+
+
+def make_elastic_corpus(root: Path, docs_per_file: int,
+                        seed: int = 0) -> tp.List[Path]:
+    """A uniform corpus: ELASTIC_FILES jsonl shards with `docs_per_file`
+    docs each, every doc ELASTIC_DOC_LEN tokens starting with its
+    (file, doc) identity — so the drill can sort any consumed batch
+    into the canonical global round-robin order and compare streams
+    across world sizes bit-exactly."""
+    import json
+    rng = np.random.default_rng(seed)
+    root.mkdir(parents=True, exist_ok=True)
+    files = []
+    for f in range(ELASTIC_FILES):
+        path = root / f"elastic.{f:02d}.jsonl"
+        with open(path, "w") as fh:
+            for d in range(docs_per_file):
+                body = rng.integers(2, 64, ELASTIC_DOC_LEN - 2)
+                fh.write(json.dumps({"tokens": [f, d] + [int(t) for t in body]})
+                         + "\n")
+        files.append(path)
+    return files
+
+
+def _canonical_steps(consumed: tp.List[np.ndarray]) -> np.ndarray:
+    """Stack per-step consumed batches with each step's rows sorted by
+    (doc index, file index) — the world-size-1 global round-robin
+    order. Two runs consumed the same tokens in the same global order
+    iff these arrays are bit-identical, whatever their world sizes."""
+    steps = []
+    for batch in consumed:
+        order = np.lexsort((batch[:, 0], batch[:, 1]))
+        steps.append(batch[order])
+    return np.stack(steps) if steps else np.zeros((0,), np.int32)
+
+
+def _elastic_solver_class():
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..datapipe import ElasticCursorGroup, ShardedTextStream, prefetch
+    from ..parallel.mesh import make_mesh
+    from ..parallel.zero import zero_sharding
+    from ..solver import BaseSolver
+
+    VOCAB, DIM = 64, 16
+
+    class ElasticSolver(BaseSolver):
+        """Tiny LM trained data-parallel over the FIRST `world` virtual
+        devices, fed by `world` per-rank sharded streams bundled in an
+        `ElasticCursorGroup`. The optimizer state is declared zero1 over
+        the data axis, so a world-size change at restore exercises the
+        full reshard path; the consumed global batch per step is a
+        world-size-independent SET (uniform corpus, docs-per-step ==
+        file count), so the canonical-order stream is the cross-world
+        oracle."""
+
+        def __init__(self, corpus_files: tp.Sequence[Path], world: int,
+                     epochs: int, steps: int):
+            super().__init__()
+            self.world = world
+            self.epochs = epochs
+            self.steps = steps
+            self.consumed: tp.List[np.ndarray] = []
+            self.mesh = make_mesh({"data": world},
+                                  devices=jax.devices()[:world])
+            self.pipe = ElasticCursorGroup([
+                prefetch(ShardedTextStream(corpus_files, shard_index=r,
+                                           num_shards=world), size=2)
+                for r in range(world)])
+            key = jax.random.PRNGKey(0)
+            params = {
+                "emb": jax.random.normal(key, (VOCAB, DIM), jnp.float32) * 0.1,
+                "out": jax.random.normal(jax.random.fold_in(key, 1),
+                                         (DIM, VOCAB), jnp.float32) * 0.1}
+            optimizer = optax.adam(1e-2)
+            state = {"params": params, "opt_state": optimizer.init(params)}
+            spec = zero_sharding(state, self.mesh, min_size=256)
+            self.state = jax.device_put(state, spec)
+            self.register_stateful("state", "pipe")
+            self.set_state_sharding("state", spec)
+            self._batch_sharding = NamedSharding(self.mesh, P("data"))
+
+            def train_step(state, tokens):
+                def loss_fn(params):
+                    hidden = params["emb"][tokens[:, :-1]]
+                    logits = hidden @ params["out"]
+                    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+                    nll = -jnp.take_along_axis(
+                        logp, tokens[:, 1:][..., None], axis=-1)[..., 0]
+                    return nll.mean()
+
+                loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+                updates, opt_state = optimizer.update(
+                    grads, state["opt_state"], state["params"])
+                params = optax.apply_updates(state["params"], updates)
+                return {"params": params, "opt_state": opt_state}, loss
+
+            # out_shardings pinned to the declared layout: the output
+            # state IS the next step's input, so the steady-state
+            # placement never drifts and no phase retraces past warm-up
+            self._step = jax.jit(
+                train_step,
+                out_shardings=(spec, NamedSharding(self.mesh, P())))
+            self._watched = False
+
+        def train_stage(self):
+            from . import chaos
+            per_call = ELASTIC_FILES // self.world
+            metrics: tp.Dict[str, float] = {}
+            for step in range(self.steps):
+                chaos.fault_point("drill.elastic_step", epoch=self.epoch,
+                                  step=step)
+                docs: tp.List[np.ndarray] = []
+                for _ in range(per_call):
+                    docs.extend(next(self.pipe))
+                batch = np.stack(docs).astype(np.int32)
+                self.consumed.append(batch)
+                tokens = jax.device_put(batch, self._batch_sharding)
+                self.state, loss = self._step(self.state, tokens)
+                metrics["loss"] = float(loss)
+            return metrics
+
+        def run(self):
+            from .. import observability
+            telemetry = observability.get_telemetry()
+            if telemetry is not None and not self._watched:
+                self._step = telemetry.watch(self._step,
+                                             name=f"elastic_step_w{self.world}")
+                self._watched = True
+            self.restore()
+            for _ in range(self.epoch, self.epochs + 1):
+                self.run_stage("train", self.train_stage)
+                self.commit()
+            self.pipe.close()
+
+    return ElasticSolver
+
+
+def _params_arrays(state: tp.Any) -> tp.List[np.ndarray]:
+    import jax
+    return [np.asarray(leaf) for leaf
+            in jax.tree_util.tree_leaves(state)]
+
+
+def _journal_types(folder: Path) -> tp.List[str]:
+    import json
+    path = folder / "telemetry.jsonl"
+    if not path.exists():
+        return []
+    types = []
+    for line in path.read_text().splitlines():
+        try:
+            types.append(json.loads(line).get("type", ""))
+        except json.JSONDecodeError:
+            continue
+    return types
+
+
+def run_elastic_drill(steps: int = 3, kill_epoch: int = 2,
+                      root: tp.Optional[str] = None, keep: bool = False,
+                      log: tp.Optional[logging.Logger] = None) -> int:
+    """8 -> 4 -> 8 virtual-device elastic drill; 0 when every check holds.
+
+    Phase A: uninterrupted baseline at world 8 (4 epochs). Phase B:
+    world 8, simulated SIGTERM mid-epoch `kill_epoch` (stops at the
+    commit boundary). Phase C: resume at world 4 (epoch 3) under strict
+    injection of transient `ckpt.reshard` + `datapipe.resplit` faults.
+    Phase D: grow back to world 8 (epoch 4), same injected faults.
+    """
+    import jax
+
+    from .. import resilience
+    from ..observability import disable_telemetry, get_telemetry
+    from ..parallel.zero import describe_state_sharding, per_device_bytes
+    from ..xp import Config, create_xp
+    from . import chaos
+
+    log = log or logger
+    epochs = 4
+    if kill_epoch != 2:
+        raise ValueError("the elastic drill's phase plan is fixed: "
+                         "kill_epoch must be 2")
+    if steps < 2:
+        # the preemption fires at step 2 of epoch `kill_epoch`; with one
+        # step per epoch that call index lands in the NEXT epoch and the
+        # drill would report spurious failures against a healthy library
+        raise ValueError(f"the elastic drill needs at least 2 steps per "
+                         f"epoch (the mid-epoch kill point), got {steps}")
+    if len(jax.devices()) < 8:
+        raise RuntimeError(
+            f"the elastic drill needs 8 virtual devices, found "
+            f"{len(jax.devices())}; run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu "
+            f"(what `make elastic-demo` does)")
+    workdir = Path(root) if root else Path(
+        tempfile.mkdtemp(prefix="flashy_elastic_"))
+    # every phase consumes one doc per file per step; 4 epochs never wrap
+    corpus = make_elastic_corpus(workdir / "corpus",
+                                 docs_per_file=epochs * steps + 2)
+    ElasticSolver = _elastic_solver_class()
+    failures: tp.List[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        if ok:
+            log.info("PASS: %s", what)
+        else:
+            log.error("FAIL: %s", what)
+            failures.append(what)
+
+    def recompiles() -> int:
+        telemetry = get_telemetry()
+        assert telemetry is not None
+        return sum(telemetry.watchdog.summary().values())
+
+    def opt_shard_ratio(solver) -> float:
+        opt = solver.state["opt_state"]
+        import jax as _jax
+        leaves = [leaf for leaf in _jax.tree_util.tree_leaves(opt)
+                  if hasattr(leaf, "sharding") and leaf.size >= 256]
+        full = sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)
+        return per_device_bytes(leaves) / full if full else 1.0
+
+    try:
+        # -------------------------------------------------- baseline --
+        log.info("phase A: uninterrupted baseline at world 8 "
+                 "(%d epochs x %d steps)", epochs, steps)
+        xp = create_xp(Config({"elastic": "baseline"}), root=workdir)
+        with xp.enter():
+            baseline = ElasticSolver(corpus, 8, epochs, steps)
+            baseline.enable_telemetry()
+            baseline.run()
+        check(recompiles() == 0,
+              "baseline: zero post-warm-up recompiles at world 8")
+        disable_telemetry()
+        base_stream = _canonical_steps(baseline.consumed)
+        check(len(baseline.consumed) == epochs * steps,
+              f"baseline consumed {epochs * steps} global batches")
+
+        # ------------------------- kill mid-epoch at world 8 ----------
+        log.info("phase B: world 8, simulated SIGTERM mid-epoch %d",
+                 kill_epoch)
+        injector = chaos.install(strict=True)
+        injector.preempt_at("drill.elastic_step",
+                            call=(kill_epoch - 1) * steps + 2)
+        chaos_cfg = Config({"elastic": "chaos"})
+        xp = create_xp(chaos_cfg, root=workdir)
+        exit_code: tp.Optional[tp.Any] = None
+        with xp.enter():
+            killed = ElasticSolver(corpus, 8, epochs, steps)
+            killed.enable_preemption_guard(install=False)
+            killed.enable_telemetry()
+            try:
+                killed.run()
+            except SystemExit as exc:
+                exit_code = exc.code
+        check(recompiles() == 0, "killed run: zero post-warm-up recompiles")
+        disable_telemetry()
+        chaos.uninstall()
+        check(exit_code == resilience.EXIT_PREEMPTED,
+              f"killed run exited with the requeue code "
+              f"{resilience.EXIT_PREEMPTED} (got {exit_code})")
+        check(len(killed.history) == kill_epoch,
+              f"kill landed after the epoch-{kill_epoch} commit "
+              f"({len(killed.history)} committed epochs)")
+        params_at_kill = _params_arrays(killed.state)
+
+        # ------------------------- shrink: resume at world 4 ----------
+        log.info("phase C: resume at world 4 (lost slice) with injected "
+                 "transient reshard + re-split faults")
+        injector = chaos.install(strict=True)
+        injector.fail_at("ckpt.reshard", call=1)
+        injector.fail_at("datapipe.resplit", call=1)
+        xp = create_xp(chaos_cfg, root=workdir)  # same cfg -> same folder
+        with xp.enter():
+            shrunk = ElasticSolver(corpus, 4, kill_epoch + 1, steps)
+            shrunk.enable_telemetry()
+            restored_probe = [None]
+
+            original_restore = shrunk.restore
+
+            def probing_restore():
+                ok = original_restore()
+                restored_probe[0] = _params_arrays(shrunk.state)
+                return ok
+
+            shrunk.restore = probing_restore
+            shrunk.run()
+            folder_c = shrunk.folder
+        check(recompiles() == 0,
+              "shrunk run: zero post-warm-up recompiles at world 4")
+        disable_telemetry()
+        check(injector.hits("ckpt.reshard", kind="fail") == 1,
+              "transient ckpt.reshard fault fired mid-reshard and was "
+              "absorbed by retry")
+        check(injector.hits("datapipe.resplit", kind="fail") == 1,
+              "transient datapipe.resplit fault fired mid-re-split and "
+              "was absorbed by retry")
+        chaos.uninstall()  # strict: raises if either never fired
+        check(restored_probe[0] is not None and all(
+            np.allclose(a, b) for a, b in zip(params_at_kill,
+                                              restored_probe[0])),
+              "transition 8->4: restored state allclose to the state "
+              "saved at world 8")
+        check(describe_state_sharding(shrunk.state)["mode"] == "zero1",
+              "restored optimizer state classifies zero1 on the 4-chip "
+              "mesh (not silently replicated)")
+        ratio_c = opt_shard_ratio(shrunk)
+        check(ratio_c <= 0.5,
+              f"restored optimizer moments hold ~1/4 per chip "
+              f"({ratio_c:.2f}x of full; silent full-replication would "
+              f"be 1.0x)")
+        check("elastic_resume" in _journal_types(folder_c),
+              "elastic_resume journal record written through the Tracer")
+        check(len(shrunk.history) == kill_epoch + 1,
+              "shrunk run committed exactly one more epoch")
+        params_after_shrink = _params_arrays(shrunk.state)
+
+        # --------------------------- grow: back to world 8 ------------
+        log.info("phase D: grow back to world 8, same injected faults")
+        injector = chaos.install(strict=True)
+        injector.fail_at("ckpt.reshard", call=1)
+        injector.fail_at("datapipe.resplit", call=1)
+        xp = create_xp(chaos_cfg, root=workdir)
+        with xp.enter():
+            grown = ElasticSolver(corpus, 8, epochs, steps)
+            grown.enable_telemetry()
+            probe_d = [None]
+            original_restore_d = grown.restore
+
+            def probing_restore_d():
+                ok = original_restore_d()
+                probe_d[0] = _params_arrays(grown.state)
+                return ok
+
+            grown.restore = probing_restore_d
+            grown.run()
+            folder_d = grown.folder
+        check(recompiles() == 0,
+              "grown run: zero post-warm-up recompiles back at world 8")
+        disable_telemetry()
+        check(injector.hits("ckpt.reshard", kind="fail") == 1
+              and injector.hits("datapipe.resplit", kind="fail") == 1,
+              "both fault sites fired and recovered again on the grow "
+              "transition")
+        chaos.uninstall()
+        check(probe_d[0] is not None and all(
+            np.allclose(a, b) for a, b in zip(params_after_shrink,
+                                              probe_d[0])),
+              "transition 4->8: restored state allclose to the state "
+              "saved at world 4")
+        check(len(grown.history) == epochs,
+              f"grown run completed all {epochs} epochs")
+        # journal from phase C is in the same folder; count records
+        check(_journal_types(folder_d).count("elastic_resume") >= 2,
+              "both elastic transitions journaled elastic_resume records")
+
+        # ----------------------- the cross-world stream oracle --------
+        elastic_stream = _canonical_steps(
+            killed.consumed + shrunk.consumed + grown.consumed)
+        check(elastic_stream.shape == base_stream.shape
+              and bool(np.array_equal(elastic_stream, base_stream)),
+              "concatenated consumed-token stream (canonical global "
+              "order) bit-identical to the uninterrupted world-8 run "
+              f"({base_stream.shape[0]} steps x {base_stream.shape[1]} "
+              "docs)")
+    finally:
+        chaos.uninstall(verify=False)
+        from .preemption import disable_preemption_guard
+        disable_preemption_guard()
+        disable_telemetry()
+        if not keep and root is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+        elif keep:
+            log.info("artifacts kept under %s", workdir)
+
+    if failures:
+        log.error("elastic drill FAILED %d checks:\n  %s", len(failures),
+                  "\n  ".join(failures))
+        return 1
+    log.info("elastic drill passed: 8->4->8 resume was token-exact with "
+             "allclose state at every transition, genuine resharding on "
+             "every mesh, and zero post-warm-up recompiles.")
+    return 0
+
+
 def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m flashy_tpu.resilience",
         description="Chaos drill: inject preemption + IO + corruption "
-                    "faults and prove resume-exactness.")
+                    "faults and prove resume-exactness. With --elastic, "
+                    "the fleet-churn drill instead: train on 8 virtual "
+                    "devices, SIGTERM mid-epoch, resume on 4, grow back "
+                    "to 8 — token-exact, allclose at every transition.")
     parser.add_argument("-e", "--epochs", type=int, default=5)
     parser.add_argument("--preempt-epoch", type=int, default=3,
                         help="epoch whose train stage takes the simulated "
                              "SIGTERM (must be > 2 so both A/B slots exist)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="run the elastic world-size drill (8->4->8 "
+                             "virtual devices) instead of the fixed-"
+                             "topology chaos drill")
+    parser.add_argument("-s", "--steps", type=int, default=3,
+                        help="steps per epoch for the elastic drill")
     parser.add_argument("--dir", default=None,
                         help="work directory (default: a fresh temp dir)")
     parser.add_argument("--keep", action="store_true",
@@ -211,6 +626,9 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="[%(levelname)s] %(message)s")
+    if args.elastic:
+        return run_elastic_drill(steps=args.steps, root=args.dir,
+                                 keep=args.keep)
     return run_drill(epochs=args.epochs, root=args.dir,
                      preempt_epoch=args.preempt_epoch, keep=args.keep)
 

@@ -210,11 +210,15 @@ def fault_point(site: str, **context: tp.Any) -> None:
     Costs one None-check when no injector is installed, so it is safe
     to leave in production IO paths. Sites in the framework today:
     ``ckpt.write`` (single-file + slot state pickles), ``ckpt.manifest``,
-    ``ckpt.pointer``, ``ckpt.load``, ``history.write``,
+    ``ckpt.pointer``, ``ckpt.load``, ``ckpt.reshard`` (the retried
+    Orbax shard read when restoring onto a different topology than the
+    save's — the elastic-resume path), ``history.write``,
     ``logger.<backend>`` (per-backend metric fan-out), the chaos
-    drill's ``drill.step``, and the datapipe drill's ``datapipe.batch``
-    (one tick per consumed packed batch — the mid-stream kill point of
-    ``python -m flashy_tpu.datapipe``).
+    drill's ``drill.step``, the elastic drill's ``drill.elastic_step``,
+    the datapipe drill's ``datapipe.batch`` (one tick per consumed
+    packed batch — the mid-stream kill point of ``python -m
+    flashy_tpu.datapipe``), and ``datapipe.resplit`` (the world-size
+    cursor re-partition of an elastic resume).
     """
     if _injector is not None:
         _injector.tick(site, **context)

@@ -399,3 +399,259 @@ def test_solver_registers_datapipe_for_preemption_close(tmp_path):
         assert [name for name, _ in pipes] == ["pipe"]
         assert pipes[0][1] is solver.pipe
         solver.pipe.close()
+
+
+# ---------------------------------------------------------------------------
+# Elastic re-split: world-size changes across resume (datapipe.elastic)
+# ---------------------------------------------------------------------------
+def _write_uniform_corpus(root, n_files=8, docs_per_file=12):
+    """Uniform unique-doc corpus: doc tokens start with (file, doc), so
+    the canonical global round-robin order is recoverable by sort."""
+    files = []
+    for f in range(n_files):
+        path = root / f"uni{f:02d}.jsonl"
+        with open(path, "w") as fh:
+            for d in range(docs_per_file):
+                fh.write(json.dumps(
+                    {"tokens": [f, d, f * 100 + d, 7]}) + "\n")
+        files.append(path)
+    return files
+
+
+def _canon(docs):
+    """Sort docs into the world-size-1 global round-robin order."""
+    return sorted((tuple(int(x) for x in d) for d in docs),
+                  key=lambda t: (t[1], t[0]))
+
+
+def _group(files, world):
+    from flashy_tpu.datapipe import ElasticCursorGroup
+    return ElasticCursorGroup([
+        ShardedTextStream(files, shard_index=r, num_shards=world)
+        for r in range(world)])
+
+
+def _consume(group, world_steps):
+    out = []
+    for _ in range(world_steps):
+        out.extend(next(group))
+    return out
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8])
+@pytest.mark.parametrize("m", [1, 2, 4, 8])
+def test_resplit_n_to_m_to_n_reproduces_stream(tmp_path, n, m):
+    """The satellite contract: N->M->N re-splits reproduce the IDENTICAL
+    token stream (canonical global order) for N, M in {1, 2, 4, 8}."""
+    K = 8
+    files = _write_uniform_corpus(tmp_path, n_files=K)
+    oracle = [next(s) for s in [ShardedTextStream(files)]
+              for _ in range(7 * K)]
+
+    g1 = _group(files, n)
+    phase1 = _consume(g1, 2 * K // n)        # 2 docs per file
+    g2 = _group(files, m)
+    g2.load_state_dict(g1.state_dict())
+    phase2 = _consume(g2, 3 * K // m)        # 3 more per file
+    g3 = _group(files, n)
+    g3.load_state_dict(g2.state_dict())
+    phase3 = _consume(g3, 2 * K // n)        # 2 more per file
+    stream = _canon(phase1) + _canon(phase2) + _canon(phase3)
+    assert stream == (_canon(oracle[:2 * K]) + _canon(oracle[2 * K:5 * K])
+                      + _canon(oracle[5 * K:7 * K]))
+
+
+def test_resplit_nonuniform_no_doc_twice_none_skipped(tmp_path):
+    """With ragged per-file doc counts the canonical-window property
+    does not hold, but per-file prefix exactness must: across a 4->2
+    re-split, every file's consumed docs are an exact in-order prefix."""
+    files = []
+    for f, count in enumerate([3, 7, 2, 9]):
+        path = tmp_path / f"rag{f}.jsonl"
+        with open(path, "w") as fh:
+            for d in range(count):
+                fh.write(json.dumps({"tokens": [f, d]}) + "\n")
+        files.append(path)
+    g1 = _group(files, 4)
+    first = _consume(g1, 2)
+    g2 = _group(files, 2)
+    g2.load_state_dict(g1.state_dict())
+    second = []
+    try:
+        for _ in range(20):
+            second.extend(next(g2))
+    except StopIteration:
+        pass
+    seen = [tuple(int(x) for x in d) for d in first + second]
+    assert len(seen) == len(set(seen))          # no doc consumed twice
+    per_file = {f: sorted(d for ff, d in seen if ff == f)
+                for f in range(4)}
+    for f, count in enumerate([3, 7, 2, 9]):    # none skipped: prefixes
+        assert per_file[f] == list(range(len(per_file[f])))
+
+
+def test_stream_level_resplit_from_world1_state(tmp_path):
+    """A world-1 cursor covers every file, so each shard of a larger
+    world can adopt it DIRECTLY via load_state_dict (the single-pipe
+    seam, no merge step needed)."""
+    files = _write_uniform_corpus(tmp_path, n_files=4)
+    whole = ShardedTextStream(files)
+    consumed = [next(whole) for _ in range(6)]
+    state = whole.state_dict()
+    shards = [ShardedTextStream(files, shard_index=r, num_shards=2)
+              for r in range(2)]
+    for shard in shards:
+        shard.load_state_dict(state)
+    rest = []
+    for shard in shards:
+        rest.extend(list(shard))
+    all_docs = [tuple(int(x) for x in d) for d in consumed + rest]
+    assert len(all_docs) == len(set(all_docs)) == 4 * 12
+
+
+def test_resplit_validations(tmp_path):
+    from flashy_tpu.datapipe import (resplit_states, resplit_stream_states,
+                                     resplit_packer_states)
+
+    files = _write_uniform_corpus(tmp_path, n_files=4)
+    states = _group(files, 4).state_dict()["per_rank"]
+    with pytest.raises(ValueError, match="every rank of the old world"):
+        resplit_stream_states(states[:3], 2)
+    with pytest.raises(ValueError, match="every rank of the old world"):
+        resplit_stream_states(states + [states[0]], 2)
+    stale = [dict(s) for s in states]
+    stale[1]["passes"] = 1
+    with pytest.raises(ValueError, match="loop pass count"):
+        resplit_stream_states(stale, 2)
+    old_format = [{k: v for k, v in s.items()
+                   if k not in ("file_cursors", "global_file_names")}
+                  for s in states]
+    with pytest.raises(ValueError, match="predates elastic"):
+        resplit_stream_states(old_format, 2)
+    renamed = [dict(s) for s in states]
+    renamed[2]["global_file_names"] = ["other.jsonl"] * 4
+    with pytest.raises(ValueError, match="different global shard lists"):
+        resplit_stream_states(renamed, 2)
+    with pytest.raises(ValueError, match="unrecognized datapipe cursor"):
+        resplit_states([{"weird": 1}], 2)
+    # packer: only at an empty-buffer boundary
+    packer_states = [{"source": s, "ready": [], "row": ([], [], []),
+                      "seg": 0, "exhausted": False} for s in states]
+    out = resplit_packer_states(packer_states, 2)
+    assert len(out) == 2 and out[0]["ready"] == []
+    packer_states[0]["row"] = ([1, 2], [1, 1], [0, 1])
+    with pytest.raises(ValueError, match="partially packed rows"):
+        resplit_packer_states(packer_states, 2)
+
+
+def test_stream_resplit_rejects_changed_global_corpus(tmp_path):
+    files = _write_uniform_corpus(tmp_path, n_files=4)
+    state = ShardedTextStream(files).state_dict()
+    extra = tmp_path / "extra.jsonl"
+    extra.write_text(json.dumps({"tokens": [9, 9]}) + "\n")
+    grown = ShardedTextStream(files + [extra], shard_index=0, num_shards=2)
+    with pytest.raises(ValueError, match="different shard files"):
+        grown.load_state_dict(state)
+
+
+def test_mixture_resplit_lockstep(tmp_path):
+    """Mixture cursors re-split when ranks are in lockstep (equal draw
+    counters): the merged sources keep per-file prefix exactness and
+    the counter-keyed schedule continues from the same draw."""
+    from flashy_tpu.datapipe import resplit_mixture_states
+
+    (tmp_path / "a").mkdir()
+    files_a = _write_uniform_corpus(tmp_path / "a", n_files=4,
+                                    docs_per_file=20)
+    (tmp_path / "b").mkdir()
+    files_b = []
+    for f in range(4):
+        path = tmp_path / "b" / f"bb{f}.jsonl"
+        with open(path, "w") as fh:
+            for d in range(20):
+                fh.write(json.dumps({"tokens": [f + 50, d]}) + "\n")
+        files_b.append(path)
+
+    def mixtures(world):
+        return [MixtureStream(
+            [ShardedTextStream(files_a, shard_index=r, num_shards=world),
+             ShardedTextStream(files_b, shard_index=r, num_shards=world)],
+            [0.5, 0.5], seed=3) for r in range(world)]
+
+    old = mixtures(2)
+    first = []
+    for _ in range(6):          # lockstep: same draw count per rank
+        for mix in old:
+            first.append(next(mix))
+    states = [m.state_dict() for m in old]
+    assert len({s["draws"] for s in states}) == 1
+    new = mixtures(4)
+    for mix, st in zip(new, resplit_mixture_states(states, 4)):
+        mix.load_state_dict(st)
+        assert mix._draws == states[0]["draws"]
+    second = []
+    for _ in range(3):
+        for mix in new:
+            second.append(next(mix))
+    seen = [tuple(int(x) for x in d) for d in first + second]
+    assert len(seen) == len(set(seen))      # no doc twice
+    # draw-count divergence is rejected
+    states[0] = dict(states[0], draws=states[0]["draws"] + 1)
+    with pytest.raises(ValueError, match="draw counter"):
+        resplit_mixture_states(states, 4)
+
+
+def test_resplit_fires_fault_site_and_retries(tmp_path):
+    """The datapipe.resplit fault site fires inside the retried unit,
+    so a transient injected failure is absorbed and the re-split still
+    lands exactly."""
+    from flashy_tpu.resilience import chaos
+
+    files = _write_uniform_corpus(tmp_path, n_files=4)
+    g1 = _group(files, 4)
+    _consume(g1, 1)
+    state = g1.state_dict()
+    injector = chaos.install(strict=True)
+    injector.fail_at("datapipe.resplit", call=1)
+    try:
+        g2 = _group(files, 2)
+        g2.load_state_dict(state)
+        assert injector.hits("datapipe.resplit", kind="fail") == 1
+        docs = _consume(g2, 2)
+        assert len({tuple(int(x) for x in d) for d in docs}) == 4
+    finally:
+        chaos.uninstall()
+
+
+def test_prefetch_resplit_delegates(tmp_path):
+    from flashy_tpu.datapipe import ElasticCursorGroup
+
+    files = _write_uniform_corpus(tmp_path, n_files=4)
+    g1 = ElasticCursorGroup([
+        prefetch(ShardedTextStream(files, shard_index=r, num_shards=4))
+        for r in range(4)])
+    first = _consume(g1, 2)
+    state = g1.state_dict()
+    g1.close()
+    g2 = ElasticCursorGroup([
+        prefetch(ShardedTextStream(files, shard_index=r, num_shards=2))
+        for r in range(2)])
+    g2.load_state_dict(state)
+    second = _consume(g2, 4)
+    g2.close()
+    assert _canon(first + second) == _canon(
+        [next(s) for s in [ShardedTextStream(files)] for _ in range(16)])
+
+
+def test_resplit_rejects_overlapping_file_ownership(tmp_path):
+    from flashy_tpu.datapipe import resplit_stream_states
+
+    files = _write_uniform_corpus(tmp_path, n_files=4)
+    states = _group(files, 2).state_dict()["per_rank"]
+    tainted = [dict(s, file_cursors=dict(s["file_cursors"]))
+               for s in states]
+    # rank 1's map also claims one of rank 0's files (stale merge)
+    stolen = next(iter(tainted[0]["file_cursors"]))
+    tainted[1]["file_cursors"][stolen] = 0
+    with pytest.raises(ValueError, match="more than one rank"):
+        resplit_stream_states(tainted, 4)

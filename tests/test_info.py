@@ -29,3 +29,59 @@ def test_info_shows_argv(tmp_path, capsys):
     xp.link.update_history([])
     assert main([str(tmp_path)]) == 0
     assert "lr=0.5" in capsys.readouterr().out
+
+
+def test_verify_report_shows_topology_and_elastic_warn():
+    from flashy_tpu.info import format_verify_report
+
+    report = {"single": None, "slots": {"slot0": []}, "active": "slot0",
+              "restorable": True}
+    topology = {"device_count": 8,
+                "mesh": {"axis_names": ["data", "fsdp"], "shape": [8, 1]},
+                "state_sharding": "zero1(data=8)"}
+    # same live world: topology shown, no WARN
+    line = format_verify_report("sig", report, topology=topology,
+                                live_devices=8)
+    assert "saved on 8 device(s) mesh(data=8) state=zero1(data=8)" in line
+    assert "WARN" not in line
+    # shrunken live world: the elastic warning names both counts
+    line = format_verify_report("sig", report, topology=topology,
+                                live_devices=4)
+    assert "WARN: live mesh has 4 device(s)" in line
+    assert "saved on 8" in line and "reshard (elastic resume)" in line
+    # no topology metadata (pre-elastic checkpoint): plain report
+    line = format_verify_report("sig", report)
+    assert "topology" not in line
+
+
+def test_verify_checkpoint_cli_prints_topology(tmp_path, capsys):
+    import jax
+    import optax
+    from flashy_tpu.info import main
+    from flashy_tpu.parallel.mesh import make_mesh
+    from flashy_tpu.parallel.zero import zero_sharding
+    from flashy_tpu.solver import BaseSolver
+    from flashy_tpu.xp import Config, create_xp
+
+    class TopoSolver(BaseSolver):
+        checkpoint_mode = "sharded"
+
+        def __init__(self):
+            super().__init__()
+            mesh = make_mesh({"data": 8})
+            params = {"w": jax.numpy.arange(64.0).reshape(8, 8)}
+            state = {"params": params,
+                     "opt_state": optax.adam(1e-3).init(params)}
+            spec = zero_sharding(state, mesh, min_size=64)
+            self.state = jax.device_put(state, spec)
+            self.register_stateful("state")
+            self.set_state_sharding("state", spec)
+
+    xp = create_xp(Config({"topo": 1}), root=tmp_path)
+    with xp.enter():
+        solver = TopoSolver()
+        solver.commit()
+    assert main([str(tmp_path), "--verify-checkpoint"]) == 0
+    out = capsys.readouterr().out
+    assert "topology: saved on 8 device(s)" in out
+    assert "zero1(data=8)" in out

@@ -718,3 +718,23 @@ def test_pipeline_packed_tick_fault_surfaces_cleanly():
         assert injector.hits("pipeline.packed_tick") == 1
     finally:
         chaos.uninstall()  # strict: raises if the armed rule never fired
+
+
+@pytest.mark.slow
+def test_elastic_drill_end_to_end(tmp_path):
+    from flashy_tpu.resilience.__main__ import run_elastic_drill
+    assert run_elastic_drill(steps=3, root=str(tmp_path)) == 0
+
+
+def test_elastic_corpus_and_canonical_order(tmp_path):
+    import numpy as np
+    from flashy_tpu.resilience.__main__ import (_canonical_steps,
+                                                make_elastic_corpus)
+    files = make_elastic_corpus(tmp_path / "c", docs_per_file=3)
+    assert len(files) == 8
+    # two permutations of the same step sort to the same canonical batch
+    batch = np.array([[f, 0] + [0] * 14 for f in range(8)], np.int32)
+    shuffled = batch[::-1].copy()
+    a = _canonical_steps([batch])
+    b = _canonical_steps([shuffled])
+    assert np.array_equal(a, b)

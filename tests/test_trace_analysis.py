@@ -437,3 +437,46 @@ def test_sweep_pipeline_leg_programs():
     labels = {p.label for p in programs}
     assert labels == {"pipeline/1f1b", "pipeline/packed_1f1b"}
     assert audit_programs(programs) == []
+
+
+# ----------------------------------------------------------------------
+# FT101 elastic leg: restore-onto-smaller-mesh replication audit
+# ----------------------------------------------------------------------
+def test_ft101_elastic_leg_clean_on_real_reshard(tmp_path):
+    # the live elastic sweep leg: a zero1 checkpoint restored
+    # topology-free onto a half-size mesh stays genuinely sharded
+    programs = demo_programs(legs=("elastic",))
+    assert len(programs) == 1
+    assert programs[0].label.startswith("elastic/restore-")
+    assert audit_programs(programs, select=["FT101"]) == []
+
+
+def test_ft101_elastic_catches_silent_full_replication(tmp_path):
+    # the planted defect this leg exists for: a reshard that "works" by
+    # gathering every leaf to every chip — values right, 1/N claim dead
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from flashy_tpu.checkpoint import load_state_sharded, save_state_sharded
+    from flashy_tpu.parallel.zero import zero_sharding
+
+    n = len(jax.devices())
+    mesh = make_mesh({"data": n})
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8 * n, 16))}
+    state = {"opt_state": optax.adam(1e-3).init(params)}
+    state = jax.device_put(state, zero_sharding(state, mesh,
+                                                min_size=16 * n))
+    directory = tmp_path / "ck.sharded"
+    save_state_sharded(state, directory)
+    half = make_mesh({"data": n // 2}, devices=jax.devices()[:n // 2])
+    restored = load_state_sharded(directory, mesh=half)
+    # simulate the fallback: gather everything to full replication
+    replicated = jax.device_put(
+        restored, jax.tree_util.tree_map(
+            lambda _: NamedSharding(half, P()), restored))
+    program = AuditProgram(
+        label="seeded/elastic-replication-fallback", state=replicated,
+        expect_sharded=("opt_state",),
+        sharded_bytes_ratio=1.0 / (n // 2) + 0.25)
+    findings = audit_programs([program], select=["FT101"])
+    assert {f.key for f in findings} == {"per-device-bytes"}
