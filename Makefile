@@ -99,8 +99,10 @@ serve-spec-demo:
 # floor, at least one copy-on-write fork, the pool conservation
 # invariant held (never over-committed), and zero post-warm-up
 # compiles across admission/prefix-hit/COW/decode/speculative
-# verify/retirement (exit 1 on any violation). Seconds; also run by
-# the tests workflow.
+# verify/retirement (exit 1 on any violation). Every pool read runs
+# the FUSED Pallas paged-decode kernel in interpret mode (the demo's
+# default; --kernel gather re-runs the XLA reference). A minute or
+# so; also run by the tests workflow.
 serve-paged-demo:
 	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --legs paged
 
@@ -165,7 +167,8 @@ docs:
 	python tools/gendocs.py -o docs/api -p flashy_tpu \
 		-c 'flashy_tpu.observability*' -c 'flashy_tpu.serve*' \
 		-c 'flashy_tpu.resilience*' -c 'flashy_tpu.parallel*' \
-		-c 'flashy_tpu.datapipe*' -c 'flashy_tpu.analysis*'
+		-c 'flashy_tpu.datapipe*' -c 'flashy_tpu.analysis*' \
+		-c 'flashy_tpu.ops*'
 
 native:
 	python tools/build_native.py

@@ -825,7 +825,7 @@ def bench_decode(jax, on_tpu: bool):
             paged_workload.append((np.concatenate([system, tail]),
                                    paged_new))
 
-        def paged_serve_run(layout: str):
+        def paged_serve_run(layout: str, kernel: str = "auto"):
             # the parity claim is DECODE throughput at equal batch, so
             # the timed window starts once every slot is live (prefill
             # differs by construction: one bucketed call dense vs
@@ -837,7 +837,8 @@ def bench_decode(jax, on_tpu: bool):
                 model, params, slots=slots, max_seq_len=cfg.max_seq_len,
                 cache_layout=layout, block_size=block_size,
                 kv_dtype="int8" if layout == "paged" else "model",
-                cache_scope=f"bench_{layout}")
+                kernel=kernel if layout == "paged" else "gather",
+                cache_scope=f"bench_{layout}_{kernel}")
             engine.warmup(
                 prompt_lengths=[len(p) for p, _ in paged_workload])
             scheduler = ContinuousBatchingScheduler(
@@ -863,7 +864,8 @@ def bench_decode(jax, on_tpu: bool):
                     scheduler.metrics.summary())
 
         dense_tok_s, dense_eng, _ = paged_serve_run("dense")
-        paged_tok_s, paged_eng, paged_summary = paged_serve_run("paged")
+        paged_tok_s, paged_eng, paged_summary = paged_serve_run(
+            "paged", kernel="gather")
         per_block = block_bytes(cfg, block_size, "int8")
         pool = paged_eng.pool_stats()
         budget = dense_eng.cache_bytes()
@@ -894,6 +896,54 @@ def bench_decode(jax, on_tpu: bool):
             f"KiB/slot, {result['max_concurrent_slots_at_fixed_hbm']} "
             f"slots at the dense {slots}-slot budget, prefix hit "
             f"{result['prefix_hit_rate'] * 100:.0f}%")
+
+        # --- fused Pallas paged decode: same workload, same engine
+        # geometry, pool reads through ops/paged_decode.py. On TPU the
+        # gate is fused >= gather at equal batch (the whole point of
+        # the kernel: close paged toward >= dense tok/s); the CPU
+        # fallback runs the kernel in interpret mode, where timings
+        # measure the interpreter, so the subleg records token PARITY
+        # and is non-gating. The analytic decode-side HBM bytes/token
+        # rides along: tok/s x bytes/token is the bandwidth the decode
+        # actually demands — the number that says "bandwidth-bound"
+        # instead of asserting it.
+        try:
+            from flashy_tpu.ops.paged_decode import (
+                decode_read_bytes_per_token)
+
+            fused_tok_s, _, _ = paged_serve_run("paged", kernel="fused")
+            # steady-state decode context of this workload: the full
+            # per-request budget (prompt + generated), mid-generation
+            mean_context = int(np.mean(
+                [len(p) + m // 2 for p, m in paged_workload[:slots]]))
+            kv_bytes_tok = decode_read_bytes_per_token(
+                cfg, mean_context, "int8")
+            result.update({
+                "fused_tokens_per_sec_per_chip": round(fused_tok_s, 1),
+                "fused_vs_gather": round(fused_tok_s / paged_tok_s, 3),
+                "fused_interpret": not on_tpu,
+                "kv_read_bytes_per_token": int(kv_bytes_tok),
+                "kv_read_bytes_per_token_model": int(
+                    decode_read_bytes_per_token(cfg, mean_context,
+                                                "model")),
+                "fused_hbm_gb_per_sec": round(
+                    fused_tok_s * kv_bytes_tok / 1e9, 3),
+            })
+            if on_tpu and fused_tok_s < paged_tok_s:
+                # the TPU gate: a fused kernel slower than the gather
+                # it replaces is a regression, not a data point
+                result["fused_violation"] = (
+                    f"fused {fused_tok_s:.0f} < gather "
+                    f"{paged_tok_s:.0f} tok/s/chip at equal batch")
+            log(f"decode fused: {paged_tok_s:.0f} (gather) -> "
+                f"{fused_tok_s:.0f} (fused) tok/s/chip "
+                f"({fused_tok_s / paged_tok_s:.2f}x"
+                f"{', interpret mode — non-gating' if not on_tpu else ''}"
+                f"), {kv_bytes_tok / 1024:.1f} KiB/token decode-side "
+                f"KV read at context {mean_context}")
+        except Exception as exc:  # noqa: BLE001  (subleg is additive)
+            log(f"decode fused sub-leg skipped: {exc}")
+            result["fused_error"] = str(exc)[:200]
     except Exception as exc:  # noqa: BLE001  (serve leg is additive)
         log(f"decode paged sub-leg skipped: {exc}")
         result["paged_error"] = str(exc)[:200]
@@ -1278,7 +1328,8 @@ _COMPACT_KEYS = {
                "spec_speedup", "acceptance_rate", "itl_ms_p95",
                "paged_tokens_per_sec_per_chip", "paged_vs_dense",
                "kv_bytes_per_slot", "max_concurrent_slots_at_fixed_hbm",
-               "prefix_hit_rate"),
+               "prefix_hit_rate", "fused_tokens_per_sec_per_chip",
+               "fused_vs_gather", "kv_read_bytes_per_token"),
     "host_sync": ("gib_per_sec",),
     "all_reduce": ("bus_bandwidth_gb_s",),
 }

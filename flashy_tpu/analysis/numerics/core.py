@@ -98,7 +98,21 @@ DATA_MOVEMENT_PRIMS = frozenset({
     "broadcast_in_dim", "concatenate", "convert_element_type", "copy",
     "dynamic_slice", "expand_dims", "gather", "pad", "reshape", "rev",
     "slice", "squeeze", "transpose",
+    # Pallas ref traffic: `get` reads a value out of a memory ref and
+    # `swap` writes one in — inside a kernel body they are the moves
+    # between HBM/VMEM refs and values, arithmetic-free. The graph
+    # models a write as the ref ALSO being an output of its swap (see
+    # REF_WRITE_PRIMS), so a value's identity survives a
+    # write-then-read round trip through scratch.
+    "get", "swap",
 })
+
+# Ref-mutating primitives (pallas kernel bodies): the written ref is
+# syntactically an INVAR, but for dataflow it is an output — later
+# `get`s of the ref read what the swap stored. ValueGraph appends the
+# ref token to these nodes' outputs so forward/backward closures cross
+# the write.
+REF_WRITE_PRIMS = frozenset({"swap", "masked_swap"})
 
 # Reduction primitives whose operand dtype IS the accumulation dtype:
 # an elementwise add chain can be audited via its carry, but these
@@ -179,6 +193,19 @@ class ValueGraph:
             self.eqns.append(eqn)
             ins = [(v, context) for v in eqn.invars if not _is_literal(v)]
             outs = [(v, context) for v in eqn.outvars]
+            if name in REF_WRITE_PRIMS and ins:
+                # the mutated ref (operand 0) is a dataflow OUTPUT:
+                # later reads of the ref see the stored value. The
+                # eqn's natural outvars are the ref's OLD content —
+                # they derive from the REF, not from the value being
+                # stored, so alias them off the ref instead of making
+                # them node outputs (a node output would hand the
+                # stored value a direct false edge into the old
+                # content; the flat ref token still over-approximates
+                # across writes, which is the sound direction).
+                for old in outs:
+                    self._alias(ins[0], old)
+                outs = [ins[0]]
             self.node_in.append(ins)
             self.node_out.append(outs)
             for token in ins:
@@ -248,6 +275,33 @@ class ValueGraph:
                 for i_var, o_var in zip(branch.outvars, eqn.outvars):
                     self._alias(inner(i_var), outer(o_var))
                 self._walk(branch, sub_context)
+        elif name == "pallas_call":
+            # The kernel body's invars are memory REFS laid out
+            # [scalar-prefetch/index args, input refs, output refs,
+            # scratch refs] while the call's invars are [scalar args,
+            # inputs] and its outvars the outputs — grid_mapping holds
+            # the counts. Stitch operand->ref and out-ref->result so a
+            # quant scale (or a cast) keeps its identity across the
+            # kernel boundary; in-body get/swap traffic is handled by
+            # DATA_MOVEMENT_PRIMS / REF_WRITE_PRIMS. This is what lets
+            # FT203 verify the scale-folding identity INSIDE the fused
+            # paged-decode kernel instead of going vacuously silent on
+            # a pallas rewrite.
+            body = _unwrap(eqn.params["jaxpr"])
+            mapping = eqn.params.get("grid_mapping")
+            n_args = len(eqn.invars)
+            n_index = getattr(mapping, "num_index_operands", 0)
+            n_in = getattr(mapping, "num_inputs", n_args - n_index)
+            n_out = getattr(mapping, "num_outputs", len(eqn.outvars))
+            for o_var, i_var in zip(eqn.invars[:n_index + n_in],
+                                    body.invars):
+                self._alias(outer(o_var), inner(i_var))
+            for j, o_var in enumerate(eqn.outvars[:n_out]):
+                ref_pos = n_index + n_in + j
+                if ref_pos < len(body.invars):
+                    self._alias(inner(body.invars[ref_pos]),
+                                outer(o_var))
+            self._walk(body, sub_context)
         else:
             # pjit / closed_call / custom_jvp/vjp / remat / shard_map —
             # and any future higher-order primitive with a 1:1 calling

@@ -2,12 +2,15 @@
 # of the per-program hooks (`DecodeEngine.executables()` covers the
 # engine's compiled registry; `parallel.audit` covers training). The
 # serving-side numerics contracts live here: the paged int8 attention
-# whose scale-folding identity FT203 structurally verifies (so a
-# future Pallas rewrite cannot silently double- or un-scale), and the
-# speculative verify forward whose rejection-sampling path is the one
-# place serve consumes PRNG keys under load. Entries are plain dicts —
-# never analysis types — so the dependency only points analysis ->
-# models.
+# whose scale-folding identity FT203 structurally verifies — in BOTH
+# spellings now: the XLA gather reference AND the fused Pallas
+# paged-decode/verify kernels (ops/paged_decode.py), whose traced
+# pallas_call bodies the ValueGraph stitches through, so a kernel
+# rewrite that double-, un- or wrong-side-scales fails `make
+# analyze-numerics` before it decodes garbage — and the speculative
+# verify forward whose rejection-sampling path is the one place serve
+# consumes PRNG keys under load. Entries are plain dicts — never
+# analysis types — so the dependency only points analysis -> models.
 """Numerics-audit program registry for models/ and ops/."""
 import typing as tp
 
@@ -16,8 +19,9 @@ __all__ = ["numerics_audit_programs"]
 
 def numerics_audit_programs() -> tp.List[tp.Dict[str, tp.Any]]:
     """NumericsProgram kwargs for the serving-side hot programs: the
-    gather-based paged int8 attention (labels `attention/...`) and the
-    [S, k+1] speculative verify (labels `serve/...`)."""
+    gather-based paged int8 attention plus its fused Pallas twin and
+    the fused [S, k+1] verify read (labels `attention/...`), and the
+    [S, k+1] speculative verify forward (labels `serve/...`)."""
     return _attention_entries() + _verify_entries()
 
 
@@ -55,10 +59,38 @@ def _attention_entries() -> tp.List[tp.Dict[str, tp.Any]]:
         return paged_write(entry_in, new_k_in, new_v_in, table_in,
                            positions_in)
 
+    from ..ops.paged_decode import (fused_paged_attention,
+                                    fused_speculative_verify)
+
+    def attend_fused(q_in, entry_in, table_in, positions_in):
+        # interpret=True pins the audited program to the same jaxpr the
+        # CPU CI traces; the pallas_call eqn (and the FT203 skeleton
+        # inside it) is identical with interpret=False on TPU
+        return fused_paged_attention(q_in, entry_in, table_in,
+                                     positions_in, head_dim=head_dim,
+                                     dtype=jnp.float32, interpret=True)
+
+    spec_k = 2
+    q_verify = jax.random.normal(
+        key, (batch, spec_k + 1, heads, head_dim), jnp.float32)
+    verify_positions = positions[:, :1] \
+        + jnp.arange(spec_k + 1, dtype=jnp.int32)[None]
+
+    def verify_fused(q_in, entry_in, table_in, positions_in):
+        return fused_speculative_verify(q_in, entry_in, table_in,
+                                        positions_in, head_dim=head_dim,
+                                        dtype=jnp.float32, interpret=True)
+
     return [
         {"label": "attention/paged-int8",
          "fn": attend,
          "example_args": (q, entry, table, positions)},
+        {"label": "attention/paged-int8-fused",
+         "fn": attend_fused,
+         "example_args": (q, entry, table, positions)},
+        {"label": "attention/paged-int8-fused-verify",
+         "fn": verify_fused,
+         "example_args": (q_verify, entry, table, verify_positions)},
         {"label": "attention/paged-int8-write",
          "fn": write,
          "example_args": (entry, new_k, new_k, table, positions),

@@ -23,6 +23,13 @@ import typing as tp
 import jax
 import jax.numpy as jnp
 
+# Symmetric int8 quantization range: values land in [-QMAX, QMAX].
+# Shared by the weight and KV paths — and by every consumer of the
+# scale-folding identity (ops/paged_attention.py's gather read and
+# ops/paged_decode.py's fused kernel both reconstruct x ~= q * scale
+# with scale = absmax / QMAX).
+QMAX = 127.0
+
 
 def is_quantized(leaf: tp.Any) -> bool:
     """True for a {"q", "scale"} quantized-tensor dict."""
@@ -35,7 +42,7 @@ def _quantize(w: jax.Array, contract_axes: tp.Sequence[int]) -> tp.Dict:
     w = w.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(w), axis=tuple(contract_axes), keepdims=True)
     scale = _safe_scale(absmax)
-    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    q = jnp.clip(jnp.round(w / scale), -QMAX, QMAX).astype(jnp.int8)
     return {"q": q, "scale": scale.astype(jnp.float32)}
 
 
@@ -120,7 +127,10 @@ def quantize_lm_params(params: tp.Any, *,
 # per-output-channel: the scale multiplies the dequantized row as one
 # broadcast, so int8->compute-dtype stays a pure elementwise op XLA
 # fuses into the attention gather instead of materializing a
-# dequantized pool copy in HBM.
+# dequantized pool copy in HBM. Two readers consume this layout under
+# one identity (FT203): the XLA gather path (ops/paged_attention.py)
+# and the fused Pallas kernel (ops/paged_decode.py) both fold K scales
+# into the scores pre-softmax and V scales into the probs post-softmax.
 
 def _safe_scale(absmax: jax.Array) -> jax.Array:
     """absmax -> quant scale with a clamped denominator.
@@ -133,7 +143,7 @@ def _safe_scale(absmax: jax.Array) -> jax.Array:
     the dequantized row is EXACTLY zero, no matter what dtype touches
     the scale later.
     """
-    return jnp.where(absmax > 0, jnp.maximum(absmax, 1e-12), 127.0) / 127.0
+    return jnp.where(absmax > 0, jnp.maximum(absmax, 1e-12), QMAX) / QMAX
 
 
 def quantize_kv(x: jax.Array) -> tp.Tuple[jax.Array, jax.Array]:
@@ -148,7 +158,7 @@ def quantize_kv(x: jax.Array) -> tp.Tuple[jax.Array, jax.Array]:
     xf = x.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
     scale = _safe_scale(absmax)
-    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    q = jnp.clip(jnp.round(xf / scale), -QMAX, QMAX).astype(jnp.int8)
     return q, scale[..., 0].astype(jnp.float32)
 
 

@@ -1,10 +1,18 @@
-# Kernel block-size autotuning. The pallas flash-attention kernels take
-# (block_q, block_k) tile sizes whose optimum depends on the chip
-# generation, head dim, and sequence length; this module measures the
-# candidates on the live backend once per shape and caches the winner
-# (process-wide, plus an optional on-disk cache so later runs skip the
-# sweep).
-"""Autotune flash-attention block sizes on the attached accelerator."""
+# Kernel block-size autotuning. The pallas kernels take tile-size
+# knobs whose optimum depends on the chip generation and shape — the
+# flash-attention kernels their (block_q, block_k) score tiles, the
+# fused paged-decode kernel its head_block (heads per grid step:
+# deeper VMEM scratch vs more pipeline steps over the block table);
+# this module measures the candidates on the live backend once per
+# shape and caches the winner (process-wide, plus an optional on-disk
+# cache so later runs skip the sweep). Every cache key leads with the
+# KERNEL NAME: two kernels tuned at coincidentally equal geometry
+# (same batch/heads/head_dim spelling) must never replay each other's
+# winner — a flash (block_q, block_k) pair is meaningless to the
+# paged kernel and vice versa. `python -m flashy_tpu.ops.tuning
+# --show` prints the persisted winners (and `--clear` drops them) so
+# a stale-looking pick is debuggable instead of a mystery.
+"""Autotune pallas kernel block sizes on the attached accelerator."""
 import functools
 import json
 import logging
@@ -15,6 +23,7 @@ import uuid
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 logger = logging.getLogger(__name__)
 
@@ -25,7 +34,7 @@ CANDIDATES: tp.Tuple[tp.Tuple[int, int], ...] = (
     (256, 512), (256, 1024), (512, 256), (512, 512),
 )
 
-_cache: tp.Dict[tp.Tuple, tp.Tuple[int, int]] = {}
+_cache: tp.Dict[tp.Tuple, tp.Any] = {}
 
 
 def _cache_path() -> str:
@@ -58,11 +67,22 @@ def _runtime_fingerprint() -> tp.Tuple[str, str]:
     return (f"jax-{jax.__version__}", f"jaxlib-{jaxlib_version}")
 
 
-def _make_key(batch: int, seq_len: int, heads: int, head_dim: int,
-              causal: bool, dtype: tp.Any, include_backward: bool) -> tp.Tuple:
-    return _runtime_fingerprint() + (
-        jax.devices()[0].device_kind, batch, seq_len, heads, head_dim,
-        causal, str(jnp.dtype(dtype)), include_backward)
+def _make_key(kernel: str, *parts: tp.Any) -> tp.Tuple:
+    """(kernel name, runtime fingerprint, device_kind, *shape parts).
+
+    The kernel name LEADS so the flash and paged-decode tunings live in
+    disjoint key spaces — the PR-8 shadowing lesson applied to the
+    cache: same-looking geometry under two kernels must never collide.
+    """
+    return (kernel,) + _runtime_fingerprint() + (
+        jax.devices()[0].device_kind,) + parts
+
+
+def _flash_key(batch: int, seq_len: int, heads: int, head_dim: int,
+               causal: bool, dtype: tp.Any,
+               include_backward: bool) -> tp.Tuple:
+    return _make_key("flash", batch, seq_len, heads, head_dim, causal,
+                     str(jnp.dtype(dtype)), include_backward)
 
 
 def lookup_tuned_blocks(batch: int, seq_len: int, heads: int, head_dim: int, *,
@@ -78,27 +98,67 @@ def lookup_tuned_blocks(batch: int, seq_len: int, heads: int, head_dim: int, *,
     cost. Returns None on a cache miss (caller keeps its defaults).
     """
     try:
-        key = _make_key(batch, seq_len, heads, head_dim, causal, dtype,
-                        include_backward)
+        key = _flash_key(batch, seq_len, heads, head_dim, causal, dtype,
+                         include_backward)
     except Exception:  # devices not initialized / no backend
         return None
+    return _coerce_pair(_lookup(key))
+
+
+def _lookup(key: tp.Tuple) -> tp.Optional[tp.Any]:
+    """Memory-then-disk cache lookup shared by every kernel's tuner."""
     if key in _cache:
         return _cache[key]
     disk_key = "/".join(str(part) for part in key)
     disk = _load_disk_cache()
     if disk_key in disk:
-        best = tuple(disk[disk_key])
-        _cache[key] = best  # type: ignore[assignment]
-        return best  # type: ignore[return-value]
+        _cache[key] = disk[disk_key]
+        return disk[disk_key]
     return None
 
 
-def _store_disk_cache(key: str, best: tp.Tuple[int, int]) -> None:
+def _coerce_pair(hit: tp.Any) -> tp.Optional[tp.Tuple[int, int]]:
+    """Disk value -> (block_q, block_k), or None on a corrupt entry.
+
+    The cache file is hand-editable (the CLI points users at it) and
+    may live on shared storage: a torn/garbage value must read as a
+    MISS (caller keeps its defaults or re-sweeps), never raise at
+    trace time."""
+    if isinstance(hit, (str, bytes)):
+        # a digit string is indexable — "128"[0] would coerce to the
+        # bogus winner (1, 2) instead of reading as corruption
+        return None
+    try:
+        pair = (int(hit[0]), int(hit[1]))
+    except (TypeError, ValueError, IndexError, KeyError):
+        return None
+    return pair if all(p > 0 for p in pair) else None
+
+
+def _coerce_int(hit: tp.Any) -> tp.Optional[int]:
+    """Disk value -> a positive int winner, or None on corruption.
+
+    Strings are corruption even when they parse: the tuner writes
+    ints, so a string is always a hand-edit — same contract as
+    `_coerce_pair`, where an indexable digit string would silently
+    mangle into a bogus winner."""
+    if isinstance(hit, (str, bytes)):
+        return None
+    try:
+        value = int(hit)
+    except (TypeError, ValueError):
+        return None
+    return value if value > 0 else None
+
+
+def _store_disk_cache(key: str, best: tp.Any) -> None:
     path = _cache_path()
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         disk = _load_disk_cache()
-        disk[key] = list(best)
+        # tuples json-round-trip as lists; scalar winners (the paged
+        # kernel's head_block) store as-is
+        disk[key] = list(best) if isinstance(best, (tuple, list)) else best
         # write-and-rename (as checkpoint.py): concurrent tuners (all
         # hosts of a pod, cache on shared storage) must never interleave
         # partial writes — a torn file would silently drop the cache.
@@ -147,16 +207,12 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int, *,
     """
     from .attention import flash_attention
 
-    key = _make_key(batch, seq_len, heads, head_dim, causal, dtype,
-                    include_backward)
-    if key in _cache:
-        return _cache[key]
+    key = _flash_key(batch, seq_len, heads, head_dim, causal, dtype,
+                     include_backward)
+    hit = _coerce_pair(_lookup(key))
+    if hit is not None:
+        return hit
     disk_key = "/".join(str(part) for part in key)
-    disk = _load_disk_cache()
-    if disk_key in disk:
-        best = tuple(disk[disk_key])
-        _cache[key] = best  # type: ignore[assignment]
-        return best  # type: ignore[return-value]
 
     viable = [(bq, bk) for bq, bk in candidates
               if seq_len % bq == 0 and seq_len % bk == 0]
@@ -196,3 +252,155 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int, *,
     _cache[key] = best
     _store_disk_cache(disk_key, best)
     return best
+
+
+# ----------------------------------------------------------------------
+# fused paged-decode kernel (ops/paged_decode.py): head_block tuning
+# ----------------------------------------------------------------------
+def _paged_key(batch: int, queries: int, heads: int, head_dim: int,
+               block_size: int, entries: int, quantized: bool,
+               dtype: tp.Any) -> tp.Tuple:
+    return _make_key("paged_decode", batch, queries, heads, head_dim,
+                     block_size, entries, quantized, str(jnp.dtype(dtype)))
+
+
+def lookup_tuned_paged_blocks(batch: int, queries: int, heads: int,
+                              head_dim: int, *, block_size: int,
+                              entries: int, quantized: bool,
+                              dtype: tp.Any) -> tp.Optional[int]:
+    """Cache-only lookup of the tuned paged-decode `head_block` —
+    NEVER sweeps (`fused_paged_attention` consults it at trace time,
+    the `lookup_tuned_blocks` convention). None on a miss."""
+    try:
+        key = _paged_key(batch, queries, heads, head_dim, block_size,
+                         entries, quantized, dtype)
+    except Exception:  # devices not initialized / no backend
+        return None
+    return _coerce_int(_lookup(key))
+
+
+def tune_paged_blocks(batch: int, queries: int, heads: int,
+                      head_dim: int, *, block_size: int, entries: int,
+                      quantized: bool = True, dtype: tp.Any = jnp.bfloat16,
+                      candidates: tp.Optional[tp.Sequence[int]] = None,
+                      reps: int = 5,
+                      interpret: tp.Optional[bool] = None) -> int:
+    """Measure fused paged-decode `head_block` candidates per
+    `device_kind`; return (and persist) the winner.
+
+    Candidates default to the divisors of `heads`; the timed program
+    is the fused kernel over a synthetic pool at exactly the serving
+    geometry (batch=S slots, queries=1 decode or k+1 verify). On CPU
+    without explicit `interpret=True` the default head_block is
+    returned unswept — interpret-mode timings are meaningless, the
+    `tune_flash_blocks` convention.
+    """
+    from .paged_decode import (_PALLAS_AVAILABLE, _default_head_block,
+                               fused_paged_attention)
+
+    key = _paged_key(batch, queries, heads, head_dim, block_size,
+                     entries, quantized, dtype)
+    hit = _coerce_int(_lookup(key))
+    if hit is not None:
+        return hit
+    disk_key = "/".join(str(part) for part in key)
+
+    if candidates is None:
+        candidates = [hb for hb in range(1, heads + 1) if heads % hb == 0]
+    viable = [hb for hb in candidates if heads % hb == 0]
+    # sweep only where the fused kernel actually RUNS: without pallas
+    # (or on cpu/gpu without explicit interpret), fused_paged_attention
+    # resolves to interpret mode (meaningless timings) or the gather
+    # fallback (head_block ignored — every candidate would time the
+    # same program and persist a noise winner, possibly onto shared
+    # storage other hosts replay); an explicit interpret=True still
+    # sweeps (mechanism tests).
+    backend = jax.default_backend()
+    if not viable or not _PALLAS_AVAILABLE \
+            or (not interpret
+                and backend in ("cpu", "gpu", "cuda", "rocm")):
+        return _default_head_block(heads)
+
+    from .paged_attention import pool_spec
+    spec = pool_spec(entries + 1, block_size, heads, head_dim, dtype,
+                     "int8" if quantized else "model")
+    rng = np.random.default_rng(0)
+    entry = {name: jnp.asarray(rng.standard_normal(shape), dt)
+             if jnp.dtype(dt) != jnp.int8
+             else jnp.asarray(rng.integers(-127, 128, shape), jnp.int8)
+             for name, (shape, dt) in spec.items()}
+    q = jnp.asarray(rng.standard_normal(
+        (batch, queries, heads, head_dim)), dtype)
+    # every slot's table full: the steady-state (worst-case) read
+    table = jnp.tile(jnp.arange(1, entries + 1, dtype=jnp.int32)[None],
+                     (batch, 1))
+    positions = (jnp.full((batch, 1), entries * block_size - queries,
+                          jnp.int32)
+                 + jnp.arange(queries, dtype=jnp.int32)[None])
+
+    def build(hb: int) -> tp.Callable[[], tp.Any]:
+        fwd = jax.jit(functools.partial(
+            fused_paged_attention, head_dim=head_dim, dtype=dtype,
+            head_block=hb, interpret=interpret))
+        return lambda: fwd(q, entry, table, positions)
+
+    timings: tp.Dict[int, float] = {}
+    for hb in viable:
+        try:
+            timings[hb] = _time_call(build(hb), reps)
+        except Exception as exc:  # tile too large for VMEM, etc.
+            logger.debug("paged tune: head_block %d failed: %s", hb, exc)
+    if not timings:
+        return _default_head_block(heads)
+    best = min(timings, key=timings.get)  # type: ignore[arg-type]
+    logger.info("paged tune %s: best head_block %d (%.3f ms); swept %d "
+                "candidates", key, best, timings[best] * 1e3,
+                len(timings))
+    _cache[key] = best
+    _store_disk_cache(disk_key, best)
+    return best
+
+
+# ----------------------------------------------------------------------
+# inspection CLI: `python -m flashy_tpu.ops.tuning --show / --clear`
+# ----------------------------------------------------------------------
+def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
+    """Print or drop the persisted tuning winners.
+
+    A stale winner silently pessimizes (or picks a tile the current
+    lowering cannot fit); when a kernel feels slow, `--show` answers
+    "what winner is this runtime replaying, for which kernel, from
+    which jax/jaxlib/device fingerprint" and `--clear` forces the next
+    run to re-sweep.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m flashy_tpu.ops.tuning",
+        description="Inspect/clear the persisted kernel tuning cache.")
+    parser.add_argument("--show", action="store_true",
+                        help="print every persisted winner, one per line")
+    parser.add_argument("--clear", action="store_true",
+                        help="delete the on-disk cache file")
+    args = parser.parse_args(argv)
+    if not (args.show or args.clear):
+        parser.error("pick --show and/or --clear")
+    path = _cache_path()
+    if args.show:
+        disk = _load_disk_cache()
+        print(f"{path}: {len(disk)} entr{'y' if len(disk) == 1 else 'ies'}")
+        for key in sorted(disk):
+            kernel = key.split("/", 1)[0]
+            print(f"  [{kernel}] {key} -> {disk[key]}")
+    if args.clear:
+        _cache.clear()
+        try:
+            os.unlink(path)
+            print(f"cleared {path}")
+        except FileNotFoundError:
+            print(f"nothing to clear at {path}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via CLI tests
+    raise SystemExit(main())

@@ -24,7 +24,11 @@
 #                a floor, the pool conservation invariant held, and
 #                zero post-warm-up compiles across admission,
 #                prefix-hit, COW fork, decode, speculative verify and
-#                retirement.
+#                retirement. By default every pool read runs the FUSED
+#                Pallas paged-decode kernel (ops/paged_decode.py) in
+#                interpret mode — the same gates, proven on the kernel
+#                the TPU serves with (--kernel gather re-runs the XLA
+#                reference path).
 """`python -m flashy_tpu.serve`: CPU continuous-batching smoke demo."""
 import argparse
 import logging
@@ -370,7 +374,7 @@ def run_chunked_demo(chunk: int = 8, seed: int = 0,
 def run_paged_demo(requests: int = 32, dense_slots: int = 4,
                    paged_slots: int = 16, block_size: int = 8, k: int = 4,
                    prefix_floor: float = 0.25, stagger: int = 4,
-                   seed: int = 0,
+                   seed: int = 0, kernel: str = "fused",
                    log: tp.Optional[logging.Logger] = None) -> int:
     """Paged KV cache acceptance gate: more slots per HBM byte, exactly.
 
@@ -387,6 +391,13 @@ def run_paged_demo(requests: int = 32, dense_slots: int = 4,
     holds (never over-committed), and zero executables were built
     post-warm-up.
 
+    `kernel='fused'` (the default — what `make serve-paged-demo`
+    gates) routes every pool read through the Pallas paged-decode
+    kernel, interpret mode on CPU: the same token-exactness +
+    zero-post-warm-up-build bar, now proven on the fused read path
+    across admission, prefix-hit, COW, decode, verify and retirement.
+    `kernel='gather'` re-runs the leg on the XLA reference path.
+
     The workload is screened to requests whose greedy argmax survives
     int8 K/V noise: a RANDOM-INIT model's logits carry near-ties far
     below the <= 0.8% quantization error, a regime trained models'
@@ -395,6 +406,7 @@ def run_paged_demo(requests: int = 32, dense_slots: int = 4,
     sharing + COW + int8 change nothing the screen didn't already
     accept about each request in isolation.
     """
+    import jax
     import numpy as np
     from ..models.decoding import generate
     from ..ops.paged_attention import block_bytes
@@ -421,20 +433,26 @@ def run_paged_demo(requests: int = 32, dense_slots: int = 4,
     engine = DecodeEngine(model, params, slots=paged_slots,
                           cache_layout="paged", block_size=block_size,
                           num_blocks=num_blocks, kv_dtype="int8",
-                          spec_k=k)
+                          kernel=kernel, spec_k=k)
     paged_bytes = engine.cache_bytes()
-    log.info("paged leg: dense budget = %d slots x %d tokens = %.0f KiB; "
-             "same budget paged+int8 = %d blocks x %d tokens -> "
-             "%d slots (%.1fx), %.0f KiB",
+    log.info("paged leg (%s kernel%s): dense budget = %d slots x %d "
+             "tokens = %.0f KiB; same budget paged+int8 = %d blocks x "
+             "%d tokens -> %d slots (%.1fx), %.0f KiB",
+             engine.kernel,
+             ", interpret mode" if engine.kernel == "fused"
+             and jax.default_backend() == "cpu" else "",
              dense_slots, dense.max_seq_len, budget / 1024,
              num_blocks - 1, block_size, paged_slots,
              paged_slots / dense_slots, paged_bytes / 1024)
 
     # --- workload: shared system prompt + per-request tail, screened
-    # for int8-argmax-safe requests (per-request, sharing disabled)
+    # for int8-argmax-safe requests (per-request, sharing disabled;
+    # SAME kernel as the serving engine, so the screen accepts exactly
+    # what the gated path will compute)
     screen = DecodeEngine(model, params, slots=1, cache_layout="paged",
                           block_size=block_size, kv_dtype="int8",
-                          prefix_cache=False, cache_scope="screen")
+                          kernel=kernel, prefix_cache=False,
+                          cache_scope="screen")
     screen.warmup()
     screen_sched = ContinuousBatchingScheduler(screen)
     workload = []
@@ -591,6 +609,12 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                         help="minimum prefix-cache hit rate the paged "
                              "leg must clear on its shared-system-"
                              "prompt workload")
+    parser.add_argument("--kernel", default="fused",
+                        choices=("gather", "fused"),
+                        help="paged pool read path for the paged leg: "
+                             "the fused Pallas kernel (interpret mode "
+                             "on CPU; the default and the CI gate) or "
+                             "the XLA gather reference")
     args = parser.parse_args(argv)
 
     legs = LEGS if args.legs == "all" else tuple(args.legs.split(","))
@@ -615,7 +639,8 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     if "paged" in legs:
         rc |= run_paged_demo(requests=args.requests,
                              k=args.spec_k, seed=args.seed,
-                             prefix_floor=args.prefix_floor)
+                             prefix_floor=args.prefix_floor,
+                             kernel=args.kernel)
     return rc
 
 

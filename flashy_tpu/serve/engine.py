@@ -145,6 +145,16 @@ class DecodeEngine:
             quantizes cache writes (per-row/per-head absmax scales
             stored beside the pool — models/quantize.quantize_kv),
             halving-or-better cache bytes and decode read bandwidth.
+        kernel: the paged pool READ implementation (paged only).
+            'gather' is the XLA reference path (and the interpret-mode
+            oracle the fused kernel is tested against); 'fused' routes
+            decode, speculative verify and chunked prefill through the
+            Pallas paged-decode kernel (ops/paged_decode.py — block
+            iteration straight off the table, in-kernel int8 dequant
+            under the FT203 scale fold, online softmax). 'auto' (the
+            default) resolves to 'fused' on TPU and 'gather'
+            elsewhere; on CPU an explicit kernel='fused' runs in
+            interpret mode (what the demo and the parity tests do).
         prefix_cache: enable cross-request prefix sharing (paged only).
         cache_scope: prefix for this engine's compile-cache keys (and
             therefore its RecompileWatchdog entry names). REQUIRED
@@ -176,6 +186,7 @@ class DecodeEngine:
                  block_size: int = 16,
                  num_blocks: tp.Optional[int] = None,
                  kv_dtype: str = "model",
+                 kernel: str = "auto",
                  prefix_cache: bool = True,
                  cache_scope: str = "",
                  compile_cache: tp.Optional[CompileCache] = None,
@@ -199,6 +210,30 @@ class DecodeEngine:
         if kv_dtype == "int8" and cache_layout != "paged":
             raise ValueError("kv_dtype='int8' requires the paged cache "
                              "layout (scales live beside the block pool)")
+        if kernel not in ("auto", "gather", "fused"):
+            raise ValueError(f"kernel must be 'auto', 'gather' or "
+                             f"'fused', got {kernel!r}")
+        if kernel == "fused" and cache_layout != "paged":
+            raise ValueError("kernel='fused' is the paged pool read "
+                             "(ops/paged_decode.py); the dense layout "
+                             "has no block tables to iterate")
+        if kernel == "fused":
+            # an explicit 'fused' must actually RUN the kernel: where
+            # it cannot (no pallas, GPU backend), the silent gather
+            # fallback would let every fused gate/label false-pass
+            from ..ops.paged_decode import fused_kernel_unsupported_reason
+            reason = fused_kernel_unsupported_reason()
+            if reason is not None:
+                raise ValueError(f"kernel='fused' cannot run here: "
+                                 f"{reason}; use kernel='gather' (or "
+                                 f"'auto')")
+        if kernel == "auto":
+            if cache_layout == "paged":
+                from ..ops.paged_decode import default_kernel
+                kernel = default_kernel()
+            else:
+                kernel = "gather"
+        self.kernel = kernel
         self.cache_layout = cache_layout
         self.kv_dtype = kv_dtype
         self.block_size = int(block_size)
@@ -339,7 +374,8 @@ class DecodeEngine:
                 # one more INPUT (contents never change the shape)
                 logits, cache = paged_apply_step(
                     model, params, cfg, tokens[:, None],
-                    positions[:, None], cache, table)
+                    positions[:, None], cache, table,
+                    kernel=self.kernel)
                 nxt = self._sample(logits[:, -1], key)
                 return jnp.where(active, nxt, jnp.int32(pad)), cache
 
@@ -407,7 +443,8 @@ class DecodeEngine:
                     table, (slot, 0), (1, table.shape[1]))
                 positions = (start + jnp.arange(size, dtype=jnp.int32))[None]
                 logits, cache = paged_apply_step(
-                    model, params, cfg, tokens, positions, cache, row)
+                    model, params, cfg, tokens, positions, cache, row,
+                    kernel=self.kernel)
                 last = jax.lax.dynamic_index_in_dim(
                     logits[0], used - 1, axis=0, keepdims=True)
                 return self._sample(last, key)[0], cache
@@ -466,7 +503,8 @@ class DecodeEngine:
                 pos = positions[:, None] \
                     + jnp.arange(k + 1, dtype=jnp.int32)[None]
                 logits, cache = paged_apply_step(
-                    model, params, cfg, toks, pos, cache, table)
+                    model, params, cfg, toks, pos, cache, table,
+                    kernel=self.kernel)
                 out, accepted = speculative_acceptance(
                     drafts, logits, temperature=self.temperature,
                     rng=key if self.temperature > 0.0 else None,

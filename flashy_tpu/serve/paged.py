@@ -491,7 +491,8 @@ class BlockPool:
 # ----------------------------------------------------------------------
 # the paged model step (device side)
 # ----------------------------------------------------------------------
-def paged_apply_step(model, params, cfg, tokens, positions, cache, table):
+def paged_apply_step(model, params, cfg, tokens, positions, cache, table,
+                     kernel: str = "gather"):
     """Forward `tokens` [B, T] at `positions` [B, T] against the pool.
 
     The paged twin of models/decoding._apply_step: same embed, MLP/MoE,
@@ -499,7 +500,13 @@ def paged_apply_step(model, params, cfg, tokens, positions, cache, table):
     read/write swapped for table-driven pool gathers/scatters
     (ops/paged_attention). `table` is [B, max_blocks] int32; every
     row's write lands at its own (block, offset), so decode, verify and
-    chunked prefill share this one implementation.
+    chunked prefill share this one implementation. `kernel` picks the
+    pool READ: 'gather' is the XLA reference (and the interpret-mode
+    oracle), 'fused' the Pallas paged-decode kernel
+    (ops/paged_decode.py) — legal here because every engine read path
+    queries consecutive positions per row, the fused kernel's one
+    extra contract. The write stays `paged_write` either way (a
+    per-row scatter XLA already fuses).
     """
     import jax
     import jax.numpy as jnp
@@ -508,6 +515,12 @@ def paged_apply_step(model, params, cfg, tokens, positions, cache, table):
                                    _kernel, _moe_forward, _postscale,
                                    _rmsnorm, _rotary, _split_heads)
     from ..ops.paged_attention import paged_attention, paged_write
+    from ..ops.paged_decode import fused_paged_attention
+
+    if kernel not in ("gather", "fused"):
+        raise ValueError(f"kernel must be 'gather' or 'fused', "
+                         f"got {kernel!r}")
+    attend = fused_paged_attention if kernel == "fused" else paged_attention
 
     def layer(bp, x, entry):
         normed = _rmsnorm(x, bp["norm1"]["scale"], cfg.dtype)
@@ -517,8 +530,8 @@ def paged_apply_step(model, params, cfg, tokens, positions, cache, table):
         q = _rotary(q, positions)
         k = _rotary(k, positions)
         entry = paged_write(entry, k, v, table, positions)
-        attn = paged_attention(q, entry, table, positions,
-                               head_dim=cfg.head_dim, dtype=cfg.dtype)
+        attn = attend(q, entry, table, positions,
+                      head_dim=cfg.head_dim, dtype=cfg.dtype)
         out_w, out_s = _kernel(bp["attn"]["out"]["kernel"], cfg.dtype)
         x = x + _postscale(jnp.einsum("bqhd,hdD->bqD", attn, out_w), out_s)
         normed = _rmsnorm(x, bp["norm2"]["scale"], cfg.dtype)

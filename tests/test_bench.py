@@ -183,6 +183,12 @@ def test_compact_line_fits_driver_tail_worst_case():
         "bubble_frac_1f1b_int2": 0.157895, "stash_flat_in_m": True,
         "recompiles": 0, "packed_step_ratio": 0.5717,
         "packed_tick_eff": 0.8984, "packed_bitwise": True,
+        # the decode sub-leg scalars (spec/paged/fused) are deliberately
+        # NOT in this maximal leg: they only ever appear in the one
+        # decode entry (never once per leg), and the runtime shed guard
+        # keeps any real overflow inside MAX_LINE_CHARS by trimming
+        # detail — the convention since the spec/paged sublegs landed.
+        "fused_vs_gather": 12.345,
         "leg_platform": "tpu",
         "comparison": {"tokens_per_sec_per_chip": 39483.2},
     }

@@ -298,6 +298,45 @@ def test_value_graph_walks_scan_boundaries():
     assert graph.dtype(b_out) == jnp.float32
 
 
+def test_value_graph_stitches_pallas_call_boundaries():
+    # the fused-kernel gate's foundation: operands alias onto the
+    # kernel body's input refs, out-refs alias onto the call's
+    # results, and a ref write-then-read (swap -> get through VMEM
+    # scratch) keeps the value's identity — so a quant scale entering
+    # a pallas_call is still "the same value" at the mul inside, and
+    # what the kernel stores reaches the program outputs.
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(x_ref, s_ref, o_ref, scratch):
+        scratch[:] = x_ref[:] * s_ref[:]
+        o_ref[:] = scratch[:] + 1.0
+
+    def f(x, s):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+            interpret=True)(x, s)
+
+    graph = ValueGraph(jax.make_jaxpr(f)(jnp.ones((8, 128)),
+                                         jnp.ones((8, 128))))
+    assert "pallas_call" in graph.prims
+    mul_nodes = [n for n, p in enumerate(graph.prims) if p == "mul"]
+    assert mul_nodes, "kernel body was not walked"
+    # the scale operand reaches the in-body mul THROUGH data movement
+    # only (ref get), the FT203 scale-identity closure
+    from flashy_tpu.analysis.numerics.core import DATA_MOVEMENT_PRIMS
+    scale_derived = graph.forward([graph.invars[1]], DATA_MOVEMENT_PRIMS)
+    assert graph.nodes_with_input(scale_derived,
+                                  frozenset({"mul"})) == mul_nodes
+    # and the mul's output reaches the program output across the
+    # scratch write/read and the out-ref boundary
+    assert graph.reaches([v for n in mul_nodes
+                          for v in graph.node_out[n]],
+                         set(graph.outvars))
+
+
 def test_is_narrow_float():
     assert is_narrow_float(jnp.bfloat16)
     assert is_narrow_float(jnp.float16)
@@ -430,7 +469,10 @@ def test_sweep_datapipe_leg_only():
 def test_sweep_attention_leg_labels():
     programs = demo_programs(legs=("attention",))
     labels = {p.label for p in programs}
-    assert labels == {"attention/paged-int8", "attention/paged-int8-write"}
+    assert labels == {"attention/paged-int8",
+                      "attention/paged-int8-fused",
+                      "attention/paged-int8-fused-verify",
+                      "attention/paged-int8-write"}
     assert audit_programs(programs) == []
 
 

@@ -242,18 +242,36 @@ def test_tune_cache_key_pins_runtime_and_device():
 
     import flashy_tpu.ops.tuning as tuning
 
-    key = tuning._make_key(1, 256, 2, 16, True, jnp.bfloat16, True)
-    assert key == tuning._make_key(1, 256, 2, 16, True, jnp.bfloat16, True)
+    key = tuning._flash_key(1, 256, 2, 16, True, jnp.bfloat16, True)
+    assert key == tuning._flash_key(1, 256, 2, 16, True, jnp.bfloat16, True)
+    assert key[0] == "flash"  # the kernel name LEADS every key
     assert f"jax-{jax.__version__}" in key
     assert any(str(part).startswith("jaxlib-") for part in key)
     assert jax.devices()[0].device_kind in key
     # every shape/config argument still participates
-    assert key != tuning._make_key(2, 256, 2, 16, True, jnp.bfloat16, True)
-    assert key != tuning._make_key(1, 256, 2, 16, False, jnp.bfloat16, True)
-    assert key != tuning._make_key(1, 256, 2, 16, True, jnp.float32, True)
+    assert key != tuning._flash_key(2, 256, 2, 16, True, jnp.bfloat16, True)
+    assert key != tuning._flash_key(1, 256, 2, 16, False, jnp.bfloat16, True)
+    assert key != tuning._flash_key(1, 256, 2, 16, True, jnp.float32, True)
     # the disk spelling round-trips through one json cache entry
     disk_key = "/".join(str(part) for part in key)
     assert disk_key.count("jax-") >= 1 and "jaxlib-" in disk_key
+    assert disk_key.startswith("flash/")
+
+
+def test_tune_cache_keys_disjoint_across_kernels():
+    # Flash and paged-decode tunings must live in disjoint key spaces:
+    # a (block_q, block_k) pair is meaningless to the paged kernel and
+    # a head_block int would corrupt a flash lookup — the cache is one
+    # shared json file, so the kernel name is the namespace.
+    import jax.numpy as jnp
+
+    import flashy_tpu.ops.tuning as tuning
+
+    flash = tuning._flash_key(1, 256, 2, 16, True, jnp.bfloat16, True)
+    paged = tuning._paged_key(1, 256, 2, 16, 16, 4, True, jnp.bfloat16)
+    assert flash[0] == "flash" and paged[0] == "paged_decode"
+    assert flash != paged
+    assert "/".join(map(str, flash)) != "/".join(map(str, paged))
 
 
 def test_flash_auto_block_for_384():
@@ -282,7 +300,7 @@ def test_lookup_tuned_blocks_cache_only(tmp_path, monkeypatch):
     tuning._cache.clear()
     assert tuning.lookup_tuned_blocks(1, 256, 2, 16) is None
 
-    key = tuning._make_key(1, 256, 2, 16, True, jnp.bfloat16, True)
+    key = tuning._flash_key(1, 256, 2, 16, True, jnp.bfloat16, True)
     tuning._store_disk_cache("/".join(str(p) for p in key), (128, 256))
     tuning._cache.clear()
     assert tuning.lookup_tuned_blocks(1, 256, 2, 16) == (128, 256)
@@ -297,7 +315,7 @@ def test_flash_attention_uses_tuned_blocks(tmp_path, monkeypatch):
     import flashy_tpu.ops.tuning as tuning
     monkeypatch.setenv("FLASHY_TPU_TUNE_CACHE", str(tmp_path / "cache.json"))
     tuning._cache.clear()
-    key = tuning._make_key(1, 256, 2, 16, True, jnp.bfloat16, True)
+    key = tuning._flash_key(1, 256, 2, 16, True, jnp.bfloat16, True)
     tuning._store_disk_cache("/".join(str(p) for p in key), (128, 128))
 
     seen = []
