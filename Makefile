@@ -106,6 +106,17 @@ serve-spec-demo:
 serve-paged-demo:
 	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --legs paged
 
+# Observability gate on CPU: the batching workload served untraced,
+# then with per-request tracing at sampling=1.0 + the SLO burn-rate
+# engine — every finished request phase-attributable from
+# requests.jsonl and the Perfetto async spans, no burn-rate alert on
+# the healthy run while serve.json carries the slo report block, zero
+# post-warm-up compiles in both passes, and full-rate tracing within
+# 2x (+2ms) of the untraced ITL p50 (exit 1 on any violation).
+# Seconds; also run by the tests workflow.
+serve-slo-demo:
+	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --legs slo
+
 # Fault-tolerance chaos drill on CPU: train with an injected transient
 # IO fault (must be absorbed by retry), a simulated mid-stage SIGTERM
 # (must stop at a boundary with the requeue exit code) and a corrupted
@@ -176,4 +187,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo chaos-demo elastic-demo zero-demo pipeline-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo serve-slo-demo chaos-demo elastic-demo zero-demo pipeline-demo datapipe-demo docs native dist

@@ -84,12 +84,13 @@ DETAIL_PATH = os.path.join(_STATE_DIR, "BENCH_DETAIL.json")
 # Budget for the single stdout JSON line: the driver records only a
 # ~2,000-char tail of stdout, so the line must stay comfortably inside
 # it (r3's multi-KB line made BENCH_r03.json parse as null).
-# 1700 still clears the ~2,000-char driver tail (plus the ~100-char
-# metric prefix) with ~200 chars of margin; raised from 1500 when the
-# pipeline leg became the 13th compact entry, and from 1600 when it
-# grew the three packed-schedule aliases (worst case measured 1665 by
+# 1800 still clears the ~2,000-char driver tail (plus the ~100-char
+# metric prefix) with ~100 chars of margin; raised from 1500 when the
+# pipeline leg became the 13th compact entry, from 1600 when it grew
+# the three packed-schedule aliases, and from 1700 when the roofline
+# leg became the 14th compact entry (worst case measured 1720 by
 # test_compact_line_fits_driver_tail_worst_case).
-MAX_LINE_CHARS = 1700
+MAX_LINE_CHARS = 1800
 
 # Peak bf16 matmul FLOP/s per chip, by device_kind substring (public
 # cloud.google.com/tpu/docs numbers).
@@ -950,6 +951,182 @@ def bench_decode(jax, on_tpu: bool):
     return result
 
 
+def bench_roofline(jax, on_tpu: bool):
+    """Per-executable roofline from XLA `cost_analysis` over measured
+    wall time (observability.RooflineProfiler): realized MFU for the LM
+    train step, realized HBM GB/s + compute-vs-bandwidth verdict for
+    the fused paged-decode serving step — each cross-checked against
+    the analytic cost model the bench already publishes.
+
+    Tolerances (the cross-check is a unit-level sanity bound, not a
+    precision claim):
+      * train step: cost_analysis FLOPs vs the analytic
+        `6*P + 6*L*T*D` per token must agree within a factor of 2 —
+        the analytic side ignores non-matmul work (norms, softmax, the
+        AdamW update) while XLA counts every HLO op.
+      * decode step: the analytic per-step stream is every parameter
+        byte (each weight is read once per step — THE decode cost at
+        small batch) plus the live slots' KV bytes
+        (`decode_read_bytes_per_token`). cost_analysis counts WHOLE
+        buffers (the full pool, sized for max_seq, not the live
+        prefix; inputs and outputs both), so it must be >= the
+        analytic stream and within 8x of it.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+    from flashy_tpu.observability import RooflineProfiler
+    from flashy_tpu.ops import lm_next_token_loss
+    from flashy_tpu.utils import device_sync
+
+    profiler = RooflineProfiler()  # peaks probed from the live device
+    result = {}
+
+    # --- LM train step: AOT compile -> cost_analysis now, timed calls
+    if on_tpu:
+        dim, layers, heads, vocab = 1024, 12, 16, 32768
+        batch, seq = 16, 1024
+        warmup, measure = 3, 10
+    else:
+        dim, layers, heads, vocab = 128, 2, 4, 512
+        batch, seq = 2, 64
+        warmup, measure = 2, 5
+    cfg = TransformerConfig(vocab_size=vocab, dim=dim, num_layers=layers,
+                            num_heads=heads, attention="dense",
+                            max_seq_len=seq,
+                            dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    model = TransformerLM(cfg)
+    params = {"params": model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]}
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(params))
+    optim = optax.adamw(1e-4)
+    state = {"params": params, "opt_state": optim.init(params)}
+
+    def train_step(state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_next_token_loss(model, p, tokens))(state["params"])
+        updates, opt_state = optim.update(grads, state["opt_state"],
+                                          state["params"])
+        return ({"params": optax.apply_updates(state["params"], updates),
+                 "opt_state": opt_state}, loss)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    compiled = jax.jit(train_step).lower(state, tokens).compile()
+    profiler.register_compiled("lm/train_step", compiled)
+    step = profiler.timed("lm/train_step", compiled)
+    for _ in range(warmup):
+        state, loss = step(state, tokens)
+    device_sync(loss)
+    # drop the warm-up calls from the record: the roofline should price
+    # the steady state, not the first-call dispatch transient
+    profiler.profiles["lm/train_step"].calls = 0
+    profiler.profiles["lm/train_step"].total_wall = 0.0
+    profiler.profiles["lm/train_step"].wall.clear()
+    for _ in range(measure):
+        state, loss = step(state, tokens)
+
+    analytic_flops = (6.0 * n_params + 6.0 * layers * seq * dim) \
+        * batch * seq
+    entry = profiler.summarize("lm/train_step") or {}
+    ratio = (entry.get("flops_per_call") / analytic_flops
+             if entry.get("flops_per_call") else None)
+    result.update({
+        "lm_tflops_per_sec": round(
+            entry["realized_flops_per_sec"] / 1e12, 3)
+        if entry.get("realized_flops_per_sec") else None,
+        "lm_mfu": round(entry["mfu"], 4) if entry.get("mfu") else None,
+        "lm_verdict": entry.get("verdict"),
+        "lm_flops_ratio_vs_analytic": round(ratio, 3) if ratio else None,
+        "lm_cost_error": entry.get("cost_error"),
+    })
+    log(f"roofline lm/train_step: "
+        f"{(entry.get('realized_flops_per_sec') or 0) / 1e12:.3f} "
+        f"TFLOP/s, mfu={entry.get('mfu')}, verdict={entry.get('verdict')}"
+        f", cost/analytic FLOPs ratio={ratio}")
+    if ratio is not None and not (0.5 <= ratio <= 2.0):
+        result["lm_flops_violation"] = (
+            f"cost_analysis/analytic FLOPs ratio {ratio:.3f} outside "
+            f"[0.5, 2.0]")
+
+    # --- fused paged-decode serving step: profiler attached to the
+    # engine's compile cache BEFORE warmup, costs deferred to report
+    try:
+        from flashy_tpu.ops.paged_decode import decode_read_bytes_per_token
+        from flashy_tpu.serve import (ContinuousBatchingScheduler,
+                                      DecodeEngine)
+
+        sdim, slayers, sheads, svocab = 128, 2, 4, 512
+        slots, prompt_len, new_tokens = 4, 8, 16
+        scfg = TransformerConfig(vocab_size=svocab, dim=sdim,
+                                 num_layers=slayers, num_heads=sheads,
+                                 attention="dense", max_seq_len=64,
+                                 dtype=jnp.bfloat16 if on_tpu
+                                 else jnp.float32)
+        smodel = TransformerLM(scfg)
+        sparams = {"params": smodel.init(
+            jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]}
+        engine = DecodeEngine(smodel, sparams, slots=slots,
+                              cache_layout="paged", kv_dtype="int8")
+        engine.attach_roofline(profiler)
+        workload = [(rng.integers(0, svocab, prompt_len).astype(np.int32),
+                     new_tokens) for _ in range(slots * 2)]
+        engine.warmup(prompt_lengths=[prompt_len])
+        scheduler = ContinuousBatchingScheduler(engine,
+                                                max_queue=len(workload))
+        for prompt, max_new in workload:
+            scheduler.submit(prompt, max_new)
+        scheduler.run()
+        decode_names = [n for n in profiler.profiles
+                        if "decode" in n and "prefill" not in n]
+        decode_name = decode_names[0] if decode_names else None
+        entry = (profiler.summarize(decode_name) or {}) \
+            if decode_name else {}
+        # analytic per-step stream: every parameter byte once, plus
+        # every live slot's whole-context K/V bytes (mid-generation
+        # context on this workload)
+        param_bytes = sum(int(np.prod(x.shape)) * x.dtype.itemsize
+                          for x in jax.tree_util.tree_leaves(sparams))
+        analytic_bytes = param_bytes + slots * decode_read_bytes_per_token(
+            scfg, prompt_len + new_tokens // 2, "int8")
+        bytes_ratio = (entry.get("bytes_per_call") / analytic_bytes
+                       if entry.get("bytes_per_call") else None)
+        result.update({
+            "decode_executable": decode_name,
+            "decode_hbm_gb_per_sec": round(
+                entry["realized_hbm_gb_per_sec"], 3)
+            if entry.get("realized_hbm_gb_per_sec") else None,
+            "decode_verdict": entry.get("verdict"),
+            "decode_intensity": round(entry["intensity"], 3)
+            if entry.get("intensity") is not None else None,
+            "decode_bytes_ratio_vs_analytic": round(bytes_ratio, 2)
+            if bytes_ratio else None,
+            "decode_cost_error": entry.get("cost_error"),
+        })
+        log(f"roofline {decode_name}: "
+            f"{entry.get('realized_hbm_gb_per_sec')} GB/s, "
+            f"intensity={entry.get('intensity')}, "
+            f"verdict={entry.get('verdict')}, "
+            f"cost/analytic bytes ratio={bytes_ratio}")
+        if bytes_ratio is not None and not (1.0 <= bytes_ratio <= 8.0):
+            result["decode_bytes_violation"] = (
+                f"cost_analysis/analytic bytes ratio {bytes_ratio:.2f} "
+                f"outside [1, 8]")
+    except Exception as exc:  # noqa: BLE001  (serve sub-leg is additive)
+        log(f"roofline decode sub-leg skipped: {exc}")
+        result["decode_error"] = str(exc)[:200]
+
+    # the full machine model + per-executable table goes to
+    # BENCH_DETAIL.json; the compact line keeps the headline scalars
+    report = profiler.report()
+    result["peak_flops"] = report["peak_flops"]
+    result["peak_hbm_gb_per_sec"] = report["peak_hbm_gb_per_sec"]
+    result["executables"] = report["executables"]
+    return result
+
+
 def _run_demo_subprocess(leg: str, module: str, args: tuple = (),
                          timeout: float = 900):
     """CPU-fallback protocol shared by the demo-backed legs (zero,
@@ -1332,6 +1509,9 @@ _COMPACT_KEYS = {
                "fused_vs_gather", "kv_read_bytes_per_token"),
     "host_sync": ("gib_per_sec",),
     "all_reduce": ("bus_bandwidth_gb_s",),
+    "roofline": ("lm_mfu", "lm_tflops_per_sec",
+                 "lm_flops_ratio_vs_analytic", "decode_hbm_gb_per_sec",
+                 "decode_verdict", "decode_bytes_ratio_vs_analytic"),
 }
 
 
@@ -1418,8 +1598,8 @@ def _persist_partial(extra: dict) -> None:
 _LEGS_FILTER = os.environ.get("FLASHY_TPU_BENCH_LEGS")
 LEG_ORDER = tuple(
     name for name in ("smoke", "mxu", "cifar", "lm", "attention", "zero",
-                      "pipeline", "ring", "gan", "decode", "datapipe",
-                      "host_sync", "all_reduce")
+                      "pipeline", "ring", "gan", "decode", "roofline",
+                      "datapipe", "host_sync", "all_reduce")
     if _LEGS_FILTER is None or name in _LEGS_FILTER.split(","))
 
 
@@ -1478,6 +1658,7 @@ def child_main() -> None:
         "pipeline": lambda: bench_pipeline(jax, on_tpu),
         "ring": lambda: bench_ring(jax, on_tpu),
         "decode": lambda: bench_decode(jax, on_tpu),
+        "roofline": lambda: bench_roofline(jax, on_tpu),
         "gan": lambda: bench_gan(jax, on_tpu),
         "datapipe": lambda: bench_datapipe(jax, on_tpu),
         "host_sync": lambda: bench_host_sync(jax, on_tpu),

@@ -15,7 +15,11 @@ from .core import (Checker, Finding, ProjectIndex, SourceFile,
 
 __all__ = ["TelemetryNameChecker", "TRACK_RE"]
 
-_TRACK_METHODS = {"counter", "instant"}
+# async_begin/async_instant/async_end carry the request-span track
+# names (serve/request, serve/request/prefill, ...) — same grouping
+# convention, same check.
+_TRACK_METHODS = {"counter", "instant",
+                  "async_begin", "async_instant", "async_end"}
 # lowercase path segments separated by '/': `serve/queue_depth`,
 # `compile_cache/miss/decode`. Dots and dashes allowed inside segments.
 TRACK_RE = re.compile(r"^[a-z0-9_.-]+(/[a-z0-9_.-]+)+$")

@@ -78,16 +78,25 @@ def format_serve_status(status: dict) -> str:
     """One-line view of a `serve.json` snapshot (flashy_tpu.serve).
 
     Shows the operator headline numbers — request tallies, TTFT and
-    inter-token latency p50/p95, occupancy — and ignores keys it does
-    not know, so the snapshot schema can grow without breaking info.
+    inter-token latency percentiles (whatever the snapshot carries:
+    p50/p95/p99 by default), occupancy — and ignores keys it does not
+    know, so the snapshot schema can grow without breaking info.
     """
     parts = []
     for key in ("requests", "completed", "rejected", "expired"):
         if key in status:
             parts.append(f"{key}={int(status[key])}")
-    for key in ("ttft_ms_p50", "ttft_ms_p95", "itl_ms_p50", "itl_ms_p95"):
-        if key in status:
+    for base in ("ttft_ms", "itl_ms"):
+        for key in sorted((k for k in status
+                           if k.startswith(f"{base}_p")
+                           and isinstance(status[k], (int, float))),
+                          key=lambda k: float(k.rsplit("_p", 1)[1])):
             parts.append(f"{key}={status[key]:.1f}")
+    if status.get("slo", {}).get("alerting"):
+        burning = [name for name, entry
+                   in status["slo"].get("budgets", {}).items()
+                   if entry.get("alerting")]
+        parts.append("SLO-ALERT[" + ",".join(burning) + "]")
     if "occupancy_p50" in status:
         parts.append(f"occupancy_p50={status['occupancy_p50'] * 100:.0f}%")
     if "acceptance_rate" in status:
@@ -248,6 +257,9 @@ def main(argv=None) -> int:
     parser.add_argument("-d", "--devices", action="store_true",
                         help="also print live per-device memory stats for "
                              "this host (initializes the JAX backend)")
+    parser.add_argument("--slo", action="store_true",
+                        help="render each XP's SLO budget/burn table from "
+                             "the `slo` block of its serve.json snapshot")
     parser.add_argument("--verify-checkpoint", action="store_true",
                         help="verify checkpoint integrity (sha256 manifests) "
                              "for every XP; exit 1 when any XP's checkpoints "
@@ -265,6 +277,14 @@ def main(argv=None) -> int:
     for entry in collect(Path(args.root)):
         found = True
         print(format_entry(entry, verbose=args.verbose))
+        if args.slo:
+            slo = (entry.get("serve") or {}).get("slo")
+            if slo:
+                from .observability import format_slo_report
+                table = format_slo_report(slo)
+                print("  slo:\n    " + table.replace("\n", "\n    "))
+            else:
+                print("  slo: no report in serve.json")
     if not found:
         print(f"no experiments under {args.root}/xps")
         return 1

@@ -5,6 +5,8 @@
 #  * StepTimer         data-wait / host / device split per training step
 #  * RecompileWatchdog WARN when a jitted fn recompiles after warm-up
 #  * Heartbeat         per-rank liveness files + cross-host straggler report
+#  * SLOEngine         declarative latency budgets + burn-rate alerting
+#  * RooflineProfiler  per-executable FLOPs/bytes -> MFU / GB/s verdicts
 #
 # `enable_telemetry()` (or `solver.enable_telemetry()`) turns everything
 # on; the solver's stage loop, LogProgressBar and DataLoader then feed
@@ -24,22 +26,31 @@
 # is only imported inside functions that genuinely touch devices.
 """Runtime telemetry: tracing, step timing, recompile and straggler watch."""
 
-from .tracer import Tracer  # noqa
+from .tracer import JsonlJournal, Tracer  # noqa
 from .steptimer import StepTimer  # noqa
 from .watchdog import RecompileWatchdog  # noqa
 from .heartbeat import (  # noqa
     Heartbeat, device_memory_stats, read_heartbeats, straggler_report,
     format_straggler_report,
 )
+from .slo import (  # noqa
+    COUNTER_SLO_BURN, DEFAULT_SLO_BUDGETS, SLOBudget, SLOEngine,
+    format_slo_report,
+)
+from .roofline import RooflineProfiler, device_peaks  # noqa
 from .telemetry import (  # noqa
     Telemetry, enable_telemetry, disable_telemetry, get_telemetry,
     TELEMETRY_NAME, TRACE_NAME, HEARTBEAT_DIR_NAME,
 )
 
 __all__ = [
-    "Tracer", "StepTimer", "RecompileWatchdog", "Heartbeat", "Telemetry",
+    "Tracer", "JsonlJournal", "StepTimer", "RecompileWatchdog",
+    "Heartbeat", "Telemetry",
     "enable_telemetry", "disable_telemetry", "get_telemetry",
     "device_memory_stats", "read_heartbeats", "straggler_report",
     "format_straggler_report",
+    "SLOBudget", "SLOEngine", "DEFAULT_SLO_BUDGETS", "format_slo_report",
+    "COUNTER_SLO_BURN",
+    "RooflineProfiler", "device_peaks",
     "TELEMETRY_NAME", "TRACE_NAME", "HEARTBEAT_DIR_NAME",
 ]

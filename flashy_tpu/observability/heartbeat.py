@@ -60,7 +60,11 @@ class Heartbeat:
     loops call it every step; `force=True` for stage boundaries). The
     write is atomic (write + rename), so readers never see a torn file.
     `with_device_stats` samples `device_memory_stats()` into each beat —
-    on-by-default live HBM occupancy per rank.
+    on-by-default live HBM occupancy per rank — and each device entry
+    additionally carries `bytes_in_use_delta` vs this rank's PREVIOUS
+    beat, so a reader can tell a rank whose memory is steadily climbing
+    (fragmenting / leaking towards an OOM stall) from one merely
+    holding a large working set.
     """
 
     def __init__(self, folder: AnyPath, rank: int = 0, world_size: int = 1,
@@ -71,6 +75,8 @@ class Heartbeat:
         self.interval = interval
         self.with_device_stats = with_device_stats
         self._last_beat = float("-inf")
+        # device id -> bytes_in_use at the previous beat (delta base)
+        self._last_bytes: tp.Dict[int, int] = {}
         self.folder.mkdir(parents=True, exist_ok=True)
 
     @property
@@ -96,7 +102,16 @@ class Heartbeat:
         }
         payload.update(extra)
         if self.with_device_stats:
-            payload["devices"] = device_memory_stats()
+            devices = device_memory_stats()
+            for entry in devices:
+                used = entry.get("bytes_in_use")
+                if used is None:
+                    continue
+                previous = self._last_bytes.get(entry["id"])
+                if previous is not None:
+                    entry["bytes_in_use_delta"] = used - previous
+                self._last_bytes[entry["id"]] = used
+            payload["devices"] = devices
         with write_and_rename(self.path, "w", pid=True) as f:
             json.dump(payload, f, default=float)
         return True
@@ -118,6 +133,22 @@ def read_heartbeats(folder: AnyPath) -> tp.List[tp.Dict[str, tp.Any]]:
     return beats
 
 
+def _rank_hbm_pressure(beat: tp.Dict[str, tp.Any]) -> tp.Optional[float]:
+    """Worst bytes_in_use/bytes_limit over a beat's devices, or None."""
+    pressures = []
+    for entry in beat.get("devices") or []:
+        limit = entry.get("bytes_limit")
+        used = entry.get("bytes_in_use")
+        if limit and used is not None:
+            pressures.append(used / limit)
+    return max(pressures) if pressures else None
+
+
+# a rank running its HBM past this fraction is close enough to the
+# allocator's ceiling that defrag/spill stalls become plausible
+HBM_PRESSURE_THRESHOLD = 0.9
+
+
 def straggler_report(folder: AnyPath,
                      now: tp.Optional[float] = None) -> tp.Dict[str, tp.Any]:
     """Cross-rank liveness summary from the heartbeat files.
@@ -126,7 +157,12 @@ def straggler_report(folder: AnyPath,
     "stalest_rank", "stalest_age", "per_rank"}`` where `max_step_skew`
     is the spread between the fastest and slowest rank's last reported
     step and `stalest_age` is seconds since the oldest heartbeat.
-    Empty folder -> ``{"ranks": 0}``.
+    When beats carry device stats, `hbm_pressure` maps each rank to its
+    worst bytes_in_use/bytes_limit fraction and `pressured_stragglers`
+    lists ranks that are BOTH behind the fastest step AND past
+    `HBM_PRESSURE_THRESHOLD` — the lag-correlates-with-memory signature
+    of a host stalling on allocator pressure rather than on input or
+    network. Empty folder -> ``{"ranks": 0}``.
     """
     beats = read_heartbeats(folder)
     if not beats:
@@ -137,7 +173,18 @@ def straggler_report(folder: AnyPath,
     steps = [b["step"] for b in beats if b.get("step") is not None]
     ages = [(now - b["time"], b.get("rank", 0)) for b in beats if "time" in b]
     stalest_age, stalest_rank = max(ages) if ages else (0.0, None)
-    return {
+    pressure = {b.get("rank", 0): p for b in beats
+                if (p := _rank_hbm_pressure(b)) is not None}
+    pressured = []
+    if pressure and steps:
+        top_step = max(steps)
+        for beat in beats:
+            rank = beat.get("rank", 0)
+            lagging = (beat.get("step") is not None
+                       and beat["step"] < top_step)
+            if lagging and pressure.get(rank, 0.0) >= HBM_PRESSURE_THRESHOLD:
+                pressured.append(rank)
+    report = {
         "ranks": len(beats),
         "expected": expected,
         "missing": sorted(set(range(expected)) - seen),
@@ -146,6 +193,10 @@ def straggler_report(folder: AnyPath,
         "stalest_age": stalest_age,
         "per_rank": beats,
     }
+    if pressure:
+        report["hbm_pressure"] = pressure
+        report["pressured_stragglers"] = pressured
+    return report
 
 
 def format_straggler_report(report: tp.Dict[str, tp.Any]) -> str:
@@ -159,4 +210,10 @@ def format_straggler_report(report: tp.Dict[str, tp.Any]) -> str:
     if report.get("stalest_rank") is not None:
         parts.append(f"stalest rank {report['stalest_rank']} "
                      f"({report['stalest_age']:.1f}s ago)")
+    if report.get("pressured_stragglers"):
+        ranks = report["pressured_stragglers"]
+        worst = max(report["hbm_pressure"][r] for r in ranks)
+        parts.append("HBM-pressured stragglers "
+                     + ",".join(str(r) for r in ranks)
+                     + f" (worst {worst:.0%})")
     return " | ".join(parts)

@@ -49,10 +49,16 @@ class StepTimer:
     """
 
     def __init__(self, stage: str = "", tracer: tp.Optional[Tracer] = None,
-                 on_step: tp.Optional[tp.Callable[[tp.Dict[str, float]], None]] = None):
+                 on_step: tp.Optional[tp.Callable[[tp.Dict[str, float]], None]] = None,
+                 percentiles: tp.Sequence[float] = (50, 95, 99)):
+        if not percentiles or not all(0 < p < 100 for p in percentiles):
+            raise ValueError(
+                f"percentiles must be a non-empty sequence in (0, 100), "
+                f"got {percentiles!r}")
         self.stage = stage
         self.tracer = tracer
         self.on_step = on_step
+        self.percentiles = tuple(percentiles)
         self.records: tp.List[tp.Dict[str, float]] = []
         self._device: float = 0.0
         self._device_at: tp.Optional[float] = None
@@ -130,17 +136,16 @@ class StepTimer:
         self._device_at = None
 
     def summary(self) -> tp.Dict[str, float]:
-        """p50/p95/max step times + where the time went, for the stage
-        metrics dict (empty when no step completed)."""
+        """Percentile step times (p50/p95/p99 by default) + max + where
+        the time went, for the stage metrics dict (empty when no step
+        completed)."""
         if not self.records:
             return {}
         totals = [r["total"] for r in self.records]
-        out: tp.Dict[str, float] = {
-            "steps": float(len(self.records)),
-            "step_p50": _percentile(totals, 50),
-            "step_p95": _percentile(totals, 95),
-            "step_max": max(totals),
-        }
+        out: tp.Dict[str, float] = {"steps": float(len(self.records))}
+        for p in self.percentiles:
+            out[f"step_p{p:g}"] = _percentile(totals, p)
+        out["step_max"] = max(totals)
         grand = sum(totals)
         for key in ("data_wait", "host", "device"):
             part = sum(r[key] for r in self.records)

@@ -20,6 +20,76 @@ import typing as tp
 from ..utils import AnyPath, write_and_rename
 
 
+class JsonlJournal:
+    """Append-only JSONL file with an optional size-capped rotation.
+
+    The journal contract (a crash keeps every line written so far)
+    plus a bound: when `max_bytes` is set and the next line would push
+    the current file past it, the file is rotated to `<name>.1` (older
+    generations shift to `.2..keep`, the oldest is dropped) and a fresh
+    file is opened whose FIRST record documents the rotation — so a
+    long-running serve job cannot fill the XP folder, and the cut
+    points are themselves part of the record.
+
+    Not thread-safe on its own: callers (Tracer, RequestTracer) hold
+    their own lock around `write_line`.
+    """
+
+    def __init__(self, path: AnyPath, max_bytes: tp.Optional[int] = None,
+                 keep: int = 3):
+        if max_bytes is not None and max_bytes < 1024:
+            raise ValueError(f"max_bytes must be >= 1024, got {max_bytes}")
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.path = Path(path)
+        self.max_bytes = max_bytes
+        self.keep = keep
+        self.rotations = 0
+        self._file: tp.Optional[tp.IO[str]] = None
+        self._size = 0
+
+    def write_line(self, line: str) -> None:
+        """Append one line (flushed); rotates first when it would not fit."""
+        data = line + "\n"
+        if self._file is None:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._file = open(self.path, "a")
+            self._size = self._file.tell()
+        if (self.max_bytes is not None and self._size > 0
+                and self._size + len(data) > self.max_bytes):
+            self._rotate()
+        self._file.write(data)
+        self._file.flush()
+        self._size += len(data)
+
+    def _rotate(self) -> None:
+        assert self._file is not None
+        self._file.close()
+        sibling = self.path.with_name
+        oldest = sibling(f"{self.path.name}.{self.keep}")
+        if oldest.exists():
+            oldest.unlink()
+        for i in range(self.keep - 1, 0, -1):
+            src = sibling(f"{self.path.name}.{i}")
+            if src.exists():
+                src.rename(sibling(f"{self.path.name}.{i + 1}"))
+        self.path.rename(sibling(f"{self.path.name}.1"))
+        self.rotations += 1
+        self._file = open(self.path, "a")
+        self._size = 0
+        note = json.dumps({"time": time.time(), "type": "journal_rotated",
+                           "rotation": self.rotations, "keep": self.keep,
+                           "max_bytes": self.max_bytes})
+        self._file.write(note + "\n")
+        self._file.flush()
+        self._size = len(note) + 1
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
 class Tracer:
     """Records host-side monotonic events and exports them.
 
@@ -37,11 +107,18 @@ class Tracer:
             journal record.
         max_events: in-memory event cap; past it new spans are counted
             as dropped instead of recorded (the journal is unaffected).
+        max_journal_bytes: size cap on `telemetry.jsonl`; past it the
+            journal rotates to `.1..journal_keep` siblings (see
+            :class:`JsonlJournal`). None (the default) keeps the
+            unbounded append-only behavior.
+        journal_keep: rotated generations retained beside the live file.
     """
 
     def __init__(self, trace_path: tp.Optional[AnyPath] = None,
                  jsonl_path: tp.Optional[AnyPath] = None,
-                 rank: int = 0, max_events: int = 200_000):
+                 rank: int = 0, max_events: int = 200_000,
+                 max_journal_bytes: tp.Optional[int] = None,
+                 journal_keep: int = 3):
         self.trace_path = Path(trace_path) if trace_path else None
         self.jsonl_path = Path(jsonl_path) if jsonl_path else None
         self.rank = rank
@@ -49,7 +126,10 @@ class Tracer:
         self.dropped = 0
         self._events: tp.List[tp.Dict[str, tp.Any]] = []
         self._lock = threading.Lock()
-        self._jsonl_file: tp.Optional[tp.IO[str]] = None
+        self._journal = (JsonlJournal(self.jsonl_path,
+                                      max_bytes=max_journal_bytes,
+                                      keep=journal_keep)
+                         if self.jsonl_path else None)
         self._t0 = time.perf_counter()
         self._add_meta("process_name", {"name": f"rank{rank}"})
 
@@ -119,6 +199,39 @@ class Tracer:
                    "ts": (time.perf_counter() - self._t0) * 1e6,
                    "pid": self.rank, "args": dict(values)})
 
+    # ------------------------------------------------------------------
+    # async spans (request-scoped tracing)
+    # ------------------------------------------------------------------
+    def _async(self, ph: str, name: str, span_id: int, category: str,
+               args: tp.Dict[str, tp.Any]) -> None:
+        self._add({"name": name, "cat": category, "ph": ph,
+                   "id": f"0x{span_id:x}",
+                   "ts": (time.perf_counter() - self._t0) * 1e6,
+                   "pid": self.rank,
+                   "tid": threading.get_ident() % (1 << 31), "args": args})
+
+    def async_begin(self, name: str, span_id: int, category: str = "serve",
+                    **args: tp.Any) -> None:
+        """Open an async ('b') span keyed by `(category, id)`.
+
+        Async spans cross thread/stack boundaries — exactly the shape of
+        a serving request, which is submitted in one call stack and
+        retired many scheduler steps later. Perfetto groups every
+        `async_*` event with the same category and id onto one track;
+        nested begin/end pairs under the same id render as sub-phases.
+        """
+        self._async("b", name, span_id, category, args)
+
+    def async_instant(self, name: str, span_id: int, category: str = "serve",
+                      **args: tp.Any) -> None:
+        """Drop an async instant ('n') marker into an open async span."""
+        self._async("n", name, span_id, category, args)
+
+    def async_end(self, name: str, span_id: int, category: str = "serve",
+                  **args: tp.Any) -> None:
+        """Close the async span opened by `async_begin` (same name + id)."""
+        self._async("e", name, span_id, category, args)
+
     @property
     def events(self) -> tp.List[tp.Dict[str, tp.Any]]:
         """Snapshot of the recorded trace events (tests, inspection)."""
@@ -134,16 +247,17 @@ class Tracer:
         `time` (unix seconds) and `rank` are stamped in; the caller owns
         the rest of the schema (e.g. StepTimer's per-step records).
         """
-        if self.jsonl_path is None:
+        if self._journal is None:
             return
         line = json.dumps({"time": time.time(), "rank": self.rank, **record},
                           default=float)
         with self._lock:
-            if self._jsonl_file is None:
-                self.jsonl_path.parent.mkdir(parents=True, exist_ok=True)
-                self._jsonl_file = open(self.jsonl_path, "a")
-            self._jsonl_file.write(line + "\n")
-            self._jsonl_file.flush()
+            self._journal.write_line(line)
+
+    @property
+    def journal_rotations(self) -> int:
+        """How many times the telemetry journal rotated (0 = never)."""
+        return self._journal.rotations if self._journal is not None else 0
 
     # ------------------------------------------------------------------
     # export
@@ -171,6 +285,5 @@ class Tracer:
         if self.trace_path is not None:
             self.export_chrome_trace()
         with self._lock:
-            if self._jsonl_file is not None:
-                self._jsonl_file.close()
-                self._jsonl_file = None
+            if self._journal is not None:
+                self._journal.close()

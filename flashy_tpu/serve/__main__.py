@@ -29,6 +29,14 @@
 #                interpret mode — the same gates, proven on the kernel
 #                the TPU serves with (--kernel gather re-runs the XLA
 #                reference path).
+#  * slo         the observability contract: the batching workload
+#                served twice (tracing off, then RequestTracer at
+#                sampling=1.0 + SLOEngine); every finished request must
+#                be phase-attributable from requests.jsonl and the
+#                Perfetto async spans, the healthy run must raise no
+#                burn-rate alert while serve.json carries the slo
+#                report, and full-rate tracing must stay within a
+#                bounded ITL overhead of the untraced run.
 """`python -m flashy_tpu.serve`: CPU continuous-batching smoke demo."""
 import argparse
 import logging
@@ -37,7 +45,7 @@ import typing as tp
 
 logger = logging.getLogger("flashy_tpu.serve.demo")
 
-LEGS = ("batching", "speculative", "chunked", "paged")
+LEGS = ("batching", "speculative", "chunked", "paged", "slo")
 
 
 def _build_model(vocab: int, seed: int):
@@ -576,6 +584,158 @@ def run_paged_demo(requests: int = 32, dense_slots: int = 4,
     return 1 if failures else 0
 
 
+def run_slo_demo(requests: int = 24, slots: int = 8, stagger: int = 3,
+                 overhead_factor: float = 2.0, seed: int = 0,
+                 log: tp.Optional[logging.Logger] = None) -> int:
+    """SLO + request-tracing acceptance gate.
+
+    Serves the batching workload twice — tracing OFF (baseline), then
+    tracing ON at sampling=1.0 with an SLOEngine attached — and exits 1
+    unless: the healthy run raises NO burn-rate alert and its
+    `serve.json` carries the `slo` report block; EVERY finished request
+    is attributable from `requests.jsonl` to named phases (queue wait /
+    prefill / decode) and from the Perfetto trace's async spans; both
+    runs stay compile-free post-warm-up; and full-rate tracing costs at
+    most `overhead_factor` x the untraced ITL p50 (+2ms CPU-noise
+    floor) — observability that slows the service down is a regression,
+    not a feature.
+    """
+    import json
+    import tempfile
+    from pathlib import Path
+
+    from ..observability import SLOEngine, Tracer, format_slo_report
+    from ..xp import REQUESTS_NAME, SERVE_STATUS_NAME, TRACE_NAME
+    from .engine import DecodeEngine
+    from .metrics import ServeMetrics
+    from .scheduler import ContinuousBatchingScheduler
+    from .tracing import (RequestTracer, SPAN_DECODE, SPAN_PREFILL,
+                          SPAN_QUEUED, SPAN_REQUEST)
+
+    log = log or logger
+    vocab = 64
+    model, params = _build_model(vocab, seed)
+    workload = _request_mix(requests, vocab, seed + 1)
+
+    def serve_pass(tracer, tracing, slo):
+        engine = DecodeEngine(model, params, slots=slots, tracer=tracer,
+                              cache_scope="traced" if tracer else "plain")
+        engine.warmup(prompt_lengths=[len(p) for p, _ in workload])
+        warm_misses = engine.compile_cache.stats()["misses"]
+        metrics = ServeMetrics(tracer=tracer, slo=slo)
+        scheduler = ContinuousBatchingScheduler(engine, metrics=metrics,
+                                                tracing=tracing)
+        handles = []
+        pending = list(workload)
+        while pending or not scheduler.idle:
+            room = scheduler.max_queue - scheduler.queue_depth
+            for _ in range(min(stagger, len(pending), room)):
+                prompt, max_new = pending.pop(0)
+                handles.append(scheduler.submit(prompt, max_new))
+            scheduler.step()
+        stats = engine.compile_cache.stats()
+        return (handles, scheduler,
+                stats["recompiles"], stats["misses"] - warm_misses)
+
+    failures = 0
+    log.info("slo leg: baseline pass (tracing off)...")
+    base_handles, base_sched, base_rec, base_builds = serve_pass(
+        None, None, None)
+    base_itl = base_sched.metrics.summary()["itl_ms_p50"]
+
+    log.info("slo leg: traced pass (sampling=1.0, SLO engine attached)...")
+    with tempfile.TemporaryDirectory() as tmp:
+        folder = Path(tmp)
+        tracer = Tracer(trace_path=folder / TRACE_NAME)
+        tracing = RequestTracer(tracer=tracer,
+                                journal_path=folder / REQUESTS_NAME,
+                                sample_rate=1.0)
+        slo = SLOEngine(tracer=tracer)
+        handles, sched, recompiles, builds = serve_pass(tracer, tracing, slo)
+        traced_itl = sched.metrics.summary()["itl_ms_p50"]
+        sched.metrics.write_status(folder)
+        tracing.close()
+        tracer.close()
+
+        if not all(h.done for h in base_handles + handles):
+            log.error("requests never finished")
+            failures += 1
+        if base_rec or base_builds or recompiles or builds:
+            log.error("steady state was not compile-free (baseline "
+                      "%d/%d, traced %d/%d recompiles/builds) — tracing "
+                      "must not perturb shapes", base_rec, base_builds,
+                      recompiles, builds)
+            failures += 1
+
+        # --- SLO gate: report present, silent on the healthy run
+        with open(folder / SERVE_STATUS_NAME) as f:
+            status = json.load(f)
+        report = status.get("slo")
+        if not report or not report.get("budgets"):
+            log.error("serve.json carries no slo report block")
+            failures += 1
+        elif report["alerting"]:
+            log.error("burn-rate alert fired on a healthy run:\n%s",
+                      format_slo_report(report))
+            failures += 1
+        else:
+            log.info("slo report (healthy, no alert):\n%s",
+                     format_slo_report(report))
+
+        # --- attribution gate: every finished uid has a journal line
+        # with its named phases, and async spans in the trace
+        finished: tp.Dict[int, tp.Dict[str, tp.Any]] = {}
+        with open(folder / REQUESTS_NAME) as f:
+            for line in f:
+                event = json.loads(line)
+                if event.get("event") == "finished":
+                    finished[event["uid"]] = event
+        for handle in handles:
+            event = finished.get(handle.uid)
+            if event is None:
+                log.error("request %d finished but has no requests.jsonl "
+                          "summary", handle.uid)
+                failures += 1
+            elif not {"queue_wait_s", "latency_s"} <= set(event):
+                log.error("request %d summary lacks phase attribution: %s",
+                          handle.uid, event)
+                failures += 1
+        spans = {}
+        with open(folder / TRACE_NAME) as f:
+            for event in json.load(f)["traceEvents"]:
+                if event.get("ph") in ("b", "e"):
+                    key = (event["name"], event["id"], event["ph"])
+                    spans[key] = spans.get(key, 0) + 1
+        for handle in handles:
+            uid = f"0x{handle.uid:x}"
+            for name in (SPAN_REQUEST, SPAN_QUEUED, SPAN_PREFILL,
+                         SPAN_DECODE):
+                opened = spans.get((name, uid, "b"), 0)
+                closed = spans.get((name, uid, "e"), 0)
+                if name == SPAN_REQUEST and (opened != 1 or closed != 1):
+                    log.error("request %d: %s opened %d / closed %d times",
+                              handle.uid, name, opened, closed)
+                    failures += 1
+                elif opened != closed:
+                    log.error("request %d: unbalanced %s spans (%d open, "
+                              "%d close)", handle.uid, name, opened, closed)
+                    failures += 1
+
+    # --- overhead gate: full-rate tracing must stay cheap
+    bound = base_itl * overhead_factor + 2.0
+    log.info("slo leg: itl p50 %.3fms untraced vs %.3fms traced at "
+             "sampling=1.0 (bound %.3fms)", base_itl, traced_itl, bound)
+    if traced_itl > bound:
+        log.error("tracing overhead blew the bound: %.3fms > %.3fms",
+                  traced_itl, bound)
+        failures += 1
+    if not failures:
+        log.info("verified: SLO report healthy, every request phase-"
+                 "attributable from requests.jsonl + Perfetto, tracing "
+                 "overhead bounded")
+    return 1 if failures else 0
+
+
 def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m flashy_tpu.serve",
@@ -641,6 +801,10 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                              k=args.spec_k, seed=args.seed,
                              prefix_floor=args.prefix_floor,
                              kernel=args.kernel)
+    if "slo" in legs:
+        rc |= run_slo_demo(requests=max(8, args.requests // 2),
+                           slots=args.slots, stagger=args.stagger,
+                           seed=args.seed)
     return rc
 
 

@@ -278,7 +278,10 @@ class DecodeEngine:
             if compile_cache is None:
                 compile_cache = CompileCache(
                     watchdog=telemetry.watchdog if telemetry else None,
-                    tracer=tracer)
+                    tracer=tracer,
+                    roofline=(telemetry.roofline
+                              if telemetry is not None
+                              and telemetry.roofline.enabled else None))
         self.tracer = tracer
         self.compile_cache = compile_cache
 
@@ -716,6 +719,13 @@ class DecodeEngine:
         programs, since compiled closures here carry no example args to
         re-trace from."""
         return self.compile_cache.executables()
+
+    def attach_roofline(self, roofline: tp.Any) -> None:
+        """Attach an `observability.RooflineProfiler` to the compile
+        cache: every executable built from now on is cost-registered
+        and timed per call. Call BEFORE `warmup()` — already-built
+        entries are not rewrapped."""
+        self.compile_cache.attach_roofline(roofline)
 
     def pool_stats(self) -> tp.Optional[tp.Dict[str, float]]:
         """Block-pool occupancy/prefix counters plus bytes-per-token

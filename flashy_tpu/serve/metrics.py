@@ -35,10 +35,28 @@ class ServeMetrics:
     (`time.perf_counter` deltas); the summary reports milliseconds —
     serving latencies read naturally in ms, and the formatter
     (`flashy_tpu.logging.serve_formatter`) keys off the `_ms` suffix.
+
+    Args:
+        tracer: optional Tracer for counter tracks + journal records.
+        percentiles: which percentiles `summary()` reports for every
+            sampled distribution (p99 is where serving tail pain
+            actually lives; p50/p95 alone hide it).
+        slo: optional `observability.SLOEngine`; when attached, every
+            TTFT / ITL / queue-wait / acceptance sample is ALSO fed to
+            it (`ttft`, `itl`, `queue_wait`, `acceptance` budgets), so
+            burn rates track live traffic with no extra plumbing.
     """
 
-    def __init__(self, tracer: tp.Optional[Tracer] = None):
+    def __init__(self, tracer: tp.Optional[Tracer] = None,
+                 percentiles: tp.Sequence[float] = (50, 95, 99),
+                 slo: tp.Optional[tp.Any] = None):
+        if not percentiles or not all(0 < p < 100 for p in percentiles):
+            raise ValueError(
+                f"percentiles must be a non-empty sequence in (0, 100), "
+                f"got {percentiles!r}")
         self.tracer = tracer
+        self.percentiles = tuple(percentiles)
+        self.slo = slo
         # non-numeric facts about the serving setup (cache layout, KV
         # dtype — filled by the scheduler from its engine); written to
         # serve.json beside the numeric summary so `flashy_tpu.info`
@@ -53,6 +71,7 @@ class ServeMetrics:
         self.ttft: tp.List[float] = []
         self.itl: tp.List[float] = []
         self.latency: tp.List[float] = []
+        self.queue_wait: tp.List[float] = []
         self.queue_depth: tp.List[int] = []
         self.occupancy: tp.List[float] = []
         # speculative decoding: proposal/acceptance accounting
@@ -87,10 +106,20 @@ class ServeMetrics:
     def on_first_token(self, ttft_seconds: float) -> None:
         self.ttft.append(ttft_seconds)
         self.tokens += 1
+        if self.slo is not None:
+            self.slo.observe("ttft", ttft_seconds)
 
     def on_token(self, gap_seconds: float) -> None:
         self.itl.append(gap_seconds)
         self.tokens += 1
+        if self.slo is not None:
+            self.slo.observe("itl", gap_seconds)
+
+    def on_queue_wait(self, wait_seconds: float) -> None:
+        """Queue wait of one admitted request (submit -> slot)."""
+        self.queue_wait.append(wait_seconds)
+        if self.slo is not None:
+            self.slo.observe("queue_wait", wait_seconds)
 
     def on_done(self, latency_seconds: float, reason: str) -> None:
         self.completed += 1
@@ -109,6 +138,9 @@ class ServeMetrics:
         self.spec_accepted += int(sum(accepted))
         self.spec_emitted += emitted
         self.accepted_per_step.extend(int(a) for a in accepted)
+        if self.slo is not None and drafted and live:
+            self.slo.observe("acceptance",
+                             sum(int(a) for a in accepted) / (drafted * live))
         if self.tracer is not None and self.spec_drafted:
             self.tracer.counter(
                 COUNTER_ACCEPTANCE,
@@ -156,7 +188,7 @@ class ServeMetrics:
     # fan-out
     # ------------------------------------------------------------------
     def summary(self) -> tp.Dict[str, float]:
-        """Flat numeric snapshot (ms latencies, p50/p95 distributions)."""
+        """Flat numeric snapshot (ms latencies, configurable percentiles)."""
         out: tp.Dict[str, float] = {
             "requests": self.submitted,
             "completed": self.completed,
@@ -167,13 +199,15 @@ class ServeMetrics:
         for name, samples, scale in (("ttft_ms", self.ttft, 1e3),
                                      ("itl_ms", self.itl, 1e3),
                                      ("latency_ms", self.latency, 1e3),
+                                     ("queue_wait_ms", self.queue_wait, 1e3),
                                      ("queue_depth", self.queue_depth, 1),
                                      ("occupancy", self.occupancy, 1)):
-            out[f"{name}_p50"] = percentile(samples, 50) * scale
-            out[f"{name}_p95"] = percentile(samples, 95) * scale
+            for p in self.percentiles:
+                out[f"{name}_p{p:g}"] = percentile(samples, p) * scale
         if self.pool_occupancy:
-            out["pool_occupancy_p50"] = percentile(self.pool_occupancy, 50)
-            out["pool_occupancy_p95"] = percentile(self.pool_occupancy, 95)
+            for p in self.percentiles:
+                out[f"pool_occupancy_p{p:g}"] = percentile(
+                    self.pool_occupancy, p)
         if self.kv_bytes_per_token:
             out["kv_bytes_per_token_p50"] = percentile(
                 self.kv_bytes_per_token, 50)
@@ -187,10 +221,9 @@ class ServeMetrics:
             out["spec_emitted"] = self.spec_emitted
             out["acceptance_rate"] = (self.spec_accepted / self.spec_drafted
                                       if self.spec_drafted else 0.0)
-            out["accepted_per_step_p50"] = percentile(
-                self.accepted_per_step, 50)
-            out["accepted_per_step_p95"] = percentile(
-                self.accepted_per_step, 95)
+            for p in self.percentiles:
+                out[f"accepted_per_step_p{p:g}"] = percentile(
+                    self.accepted_per_step, p)
         for reason, count in sorted(self.finish_reasons.items()):
             out[f"finish_{reason}"] = count
         return out
@@ -213,10 +246,14 @@ class ServeMetrics:
     def write_status(self, folder: AnyPath,
                      extra: tp.Optional[tp.Dict[str, tp.Any]] = None) -> Path:
         """Snapshot the summary to `<folder>/serve.json` (atomic) for
-        `python -m flashy_tpu.info`; returns the path."""
+        `python -m flashy_tpu.info`; returns the path. When an SLOEngine
+        is attached its evaluation lands as the `slo` block (what
+        `info --slo` renders)."""
         target = Path(folder) / SERVE_STATUS_NAME
         payload: tp.Dict[str, tp.Any] = dict(self.static_info)
         payload.update(self.summary())
+        if self.slo is not None:
+            payload["slo"] = self.slo.evaluate()
         if extra:
             payload.update(extra)
         target.parent.mkdir(parents=True, exist_ok=True)

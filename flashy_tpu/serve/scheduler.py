@@ -17,6 +17,7 @@ import typing as tp
 
 import numpy as np
 
+from ..resilience import chaos
 from .engine import DecodeEngine
 from .metrics import ServeMetrics
 from .paged import PoolExhausted
@@ -53,6 +54,7 @@ class Request:
     generated: tp.List[int] = dataclasses.field(default_factory=list)
     submitted_at: float = 0.0
     deadline: tp.Optional[float] = None  # absolute; None = no TTL
+    admitted_at: tp.Optional[float] = None
     first_token_at: tp.Optional[float] = None
     finished_at: tp.Optional[float] = None
     finish_reason: tp.Optional[str] = None  # 'eos' | 'length' | 'expired'
@@ -109,15 +111,20 @@ class ContinuousBatchingScheduler:
             warm-up covered exactly that verify shape).
         prefill_chunks_per_step: chunked-prefill slices advanced per
             scheduler step (the prefill/decode interleave ratio).
+        tracing: optional `serve.tracing.RequestTracer`; every request
+            lifecycle transition is mirrored to it (async Perfetto
+            spans + requests.jsonl), subject to its sampling policy.
     """
 
     def __init__(self, engine: DecodeEngine, max_queue: int = 128,
                  metrics: tp.Optional[ServeMetrics] = None,
                  draft: tp.Optional[tp.Any] = None,
-                 prefill_chunks_per_step: int = 1):
+                 prefill_chunks_per_step: int = 1,
+                 tracing: tp.Optional[tp.Any] = None):
         self.engine = engine
         self.max_queue = max_queue
         self.metrics = metrics or ServeMetrics(tracer=engine.tracer)
+        self.tracing = tracing
         self.metrics.static_info.setdefault("cache_layout",
                                             engine.cache_layout)
         self.metrics.static_info.setdefault("kv_dtype", engine.kv_dtype)
@@ -194,6 +201,8 @@ class ContinuousBatchingScheduler:
             raise ValueError(f"ttl must be positive (seconds), got {ttl}")
         if len(self._queue) >= self.max_queue:
             self.metrics.on_reject()
+            if self.tracing is not None:
+                self.tracing.on_reject(len(self._queue))
             raise QueueFull(
                 f"admission queue is at capacity ({self.max_queue}); "
                 f"retry after in-flight requests drain")
@@ -204,6 +213,8 @@ class ContinuousBatchingScheduler:
                           deadline=now + ttl if ttl is not None else None)
         self._queue.append(request)
         self.metrics.on_submit()
+        if self.tracing is not None:
+            self.tracing.on_submit(request)
         return request
 
     def _shed_expired(self, now: tp.Optional[float] = None) -> int:
@@ -224,6 +235,8 @@ class ContinuousBatchingScheduler:
                 request.finish_reason = "expired"
                 request.finished_at = now
                 self.metrics.on_expired()
+                if self.tracing is not None:
+                    self.tracing.on_finish(request, "expired")
                 shed += 1
                 logger.debug("request %d expired after %.3fs in queue",
                              request.uid, now - request.submitted_at)
@@ -241,6 +254,8 @@ class ContinuousBatchingScheduler:
         request.first_token_at = now
         request.generated.append(first)
         self.metrics.on_first_token(now - request.submitted_at)
+        if self.tracing is not None:
+            self.tracing.on_first_token(request)
         if request.eos_token is not None and first == request.eos_token:
             self._finish(request, "eos")
         elif len(request.generated) >= request.max_new_tokens:
@@ -271,6 +286,8 @@ class ContinuousBatchingScheduler:
                 request.finish_reason = "expired"
                 request.finished_at = time.perf_counter()
                 self.metrics.on_expired()
+                if self.tracing is not None:
+                    self.tracing.on_finish(request, "expired")
                 continue
             if not self.engine.can_admit(request.prompt,
                                          request.max_new_tokens):
@@ -301,6 +318,11 @@ class ContinuousBatchingScheduler:
             if self.engine.cache_layout == "paged":
                 self.metrics.on_prefix(start, int(request.prompt.size))
             request.slot = slot
+            request.admitted_at = time.perf_counter()
+            self.metrics.on_queue_wait(
+                request.admitted_at - request.submitted_at)
+            if self.tracing is not None:
+                self.tracing.on_admit(request, slot, start)
             self.admitted_order.append(request.uid)
             admitted += 1
             if self.engine.chunk is None:
@@ -322,6 +344,8 @@ class ContinuousBatchingScheduler:
             new_start, first = self.engine.prefill_chunk(
                 slot, request.prompt, start)
             budget -= 1
+            if self.tracing is not None:
+                self.tracing.on_prefill_chunk(request, start, new_start)
             self.prefill_tokens_last_step += new_start - start
             if first is None:
                 self._prefilling[slot][1] = new_start
@@ -345,6 +369,8 @@ class ContinuousBatchingScheduler:
             self.draft.retire(request.slot)
         self.metrics.on_done(request.finished_at - request.submitted_at,
                              reason)
+        if self.tracing is not None:
+            self.tracing.on_finish(request, reason)
         logger.debug("request %d done (%s): %d prompt + %d generated",
                      request.uid, reason, request.prompt.size,
                      len(request.generated))
@@ -374,7 +400,21 @@ class ContinuousBatchingScheduler:
 
     def step(self) -> int:
         """Shed expired + admit/advance prefill + one decode (or
-        speculative verify) step + retire; returns #tokens emitted."""
+        speculative verify) step + retire; returns #tokens emitted.
+
+        A crash anywhere in the step closes every in-flight request
+        span first (`tracing.finalize('crashed')` — the finalize
+        convention: the trace stays loadable and the journal records
+        how far each request got) and then propagates.
+        """
+        try:
+            return self._step()
+        except Exception:
+            if self.tracing is not None:
+                self.tracing.finalize("crashed")
+            raise
+
+    def _step(self) -> int:
         self._shed_expired()
         self._admit()
         self.metrics.on_gauges(queue_depth=len(self._queue),
@@ -391,13 +431,21 @@ class ContinuousBatchingScheduler:
         if not self._running:
             return 0
         step_start = time.perf_counter()
+        # inside the ITL-measured region on purpose: an injected delay
+        # here lands in the per-token `gap` the SLO engine samples, and
+        # an injected raise still unwinds through step()'s finalize
+        chaos.fault_point("serve.step", queue_depth=len(self._queue),
+                          live=len(self._running))
         if self.draft is None:
             tokens = self.engine.decode()
             gap = time.perf_counter() - step_start
             emitted = 0
             for slot, request in list(self._running.items()):
-                kept, _ = self._feed(slot, request, [int(tokens[slot])], gap)
+                kept, finished = self._feed(slot, request,
+                                            [int(tokens[slot])], gap)
                 emitted += kept
+                if not finished and self.tracing is not None:
+                    self.tracing.on_step_tokens(request, kept)
             return emitted
 
         # speculative step: k drafted tokens per slot verified in ONE
@@ -415,6 +463,9 @@ class ContinuousBatchingScheduler:
             kept, finished = self._feed(slot, request, span, gap)
             emitted += kept
             if not finished:
+                if self.tracing is not None:
+                    self.tracing.on_step_tokens(
+                        request, kept, accepted=int(accepted[slot]))
                 self.draft.observe(slot, span[:kept],
                                    self.engine.slot_length(slot))
         self.metrics.on_spec_step(drafted=int(drafts.shape[1]),

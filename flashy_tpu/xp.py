@@ -51,6 +51,8 @@ HEARTBEAT_DIR_NAME = "heartbeats"
 # Serving status snapshot (written by flashy_tpu.serve's metrics
 # surface; flashy_tpu.info shows it next to the training history).
 SERVE_STATUS_NAME = "serve.json"
+# Per-request lifecycle journal (flashy_tpu.serve.tracing.RequestTracer).
+REQUESTS_NAME = "requests.jsonl"
 
 
 class Config(dict):
