@@ -117,6 +117,21 @@ serve-paged-demo:
 serve-slo-demo:
 	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --legs slo
 
+# Serving-fleet gate on CPU, all four legs: disaggregated prefill->
+# decode handoff over one shared block pool (block-list transfer,
+# token-exact vs per-request generate(), zero post-warm-up compiles on
+# both engines), sticky prefix routing >= round-robin prefix hit rate
+# on a shared-system-prompt workload with replayable deterministic
+# decisions, priority preemption (victims evicted, re-queued and
+# finished token-exactly, per-tenant rollups in serve.json, pool
+# conservation throughout), and the engine-death drill (strict
+# fleet.engine_step injection mid-decode, every in-flight request
+# re-routed and re-served token-exactly, death recorded in
+# fleet.json). Exit 1 on any violation. A minute or so; also run by
+# the tests workflow.
+fleet-demo:
+	JAX_PLATFORMS=cpu python -m flashy_tpu.serve.fleet
+
 # Fault-tolerance chaos drill on CPU: train with an injected transient
 # IO fault (must be absorbed by retry), a simulated mid-stage SIGTERM
 # (must stop at a boundary with the requeue exit code) and a corrupted
@@ -177,6 +192,7 @@ datapipe-demo:
 docs:
 	python tools/gendocs.py -o docs/api -p flashy_tpu \
 		-c 'flashy_tpu.observability*' -c 'flashy_tpu.serve*' \
+		-c 'flashy_tpu.serve.fleet*' \
 		-c 'flashy_tpu.resilience*' -c 'flashy_tpu.parallel*' \
 		-c 'flashy_tpu.datapipe*' -c 'flashy_tpu.analysis*' \
 		-c 'flashy_tpu.ops*'
@@ -187,4 +203,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo serve-slo-demo chaos-demo elastic-demo zero-demo pipeline-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo serve-slo-demo fleet-demo chaos-demo elastic-demo zero-demo pipeline-demo datapipe-demo docs native dist

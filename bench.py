@@ -951,6 +951,91 @@ def bench_decode(jax, on_tpu: bool):
     return result
 
 
+def bench_fleet(jax, on_tpu: bool):
+    """Serving-fleet scaling: aggregate tok/s/chip through the router-
+    fronted deployment at 1 vs 2 vs 4 engines, plus shed rate and TTFT
+    p95 under an over-admission burst (every request submitted up
+    front against a finite tenant quota — the door sheds the
+    overflow, the survivors' TTFT shows the queueing cost)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+    from flashy_tpu.serve.fleet import (QuotaManager, ServingFleet,
+                                        TenantQuota)
+
+    if on_tpu:
+        dim, layers, heads, vocab = 512, 4, 8, 4096
+        slots, new_tokens = 8, 32
+    else:
+        dim, layers, heads, vocab = 128, 2, 4, 512
+        slots, new_tokens = 4, 12
+    cfg = TransformerConfig(vocab_size=vocab, dim=dim, num_layers=layers,
+                            num_heads=heads, attention="dense",
+                            max_seq_len=64,
+                            dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    params = {"params": model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]}
+    system = rng.integers(0, vocab, 16).astype(np.int32)
+
+    quota_cap = 2 * 4 * slots  # vs the 4-engine fleet's slot count
+    # one shared-system-prompt burst, identical for every fleet size
+    # (fair scaling comparison), sized to over-admit (3x the quota
+    # cap) so the shed path is always exercised
+    prompts = []
+    for i in range(3 * quota_cap):
+        tail = rng.integers(0, vocab, 3 + i % 6).astype(np.int32)
+        prompts.append(np.concatenate([system, tail])
+                       if i % 2 == 0 else tail)
+    chips = max(len(jax.devices()), 1)
+    result = {}
+    per_engines = {}
+    for engines in (1, 2, 4):
+        fleet = ServingFleet.build(
+            model, params, engines=engines, slots=slots, block_size=16,
+            max_queue=4 * quota_cap, kernel="fused" if on_tpu else "gather",
+            quotas=QuotaManager(default=TenantQuota(
+                max_inflight=quota_cap)))
+        fleet.warmup(prompt_lengths=[len(p) for p in prompts])
+        from flashy_tpu.serve import QueueFull
+        handles, sheds = [], 0
+        begin = time.perf_counter()
+        for prompt in prompts:
+            try:
+                handles.append(fleet.submit(prompt, new_tokens))
+            except QueueFull:
+                sheds += 1
+        fleet.run()
+        elapsed = time.perf_counter() - begin
+        tokens = sum(len(h.generated) for h in handles)
+        tok_s = tokens / elapsed / chips
+        ttft = np.concatenate([m.scheduler.metrics.ttft or [0.0]
+                               for m in fleet.members.values()])
+        entry = {"tokens_per_sec_per_chip": round(tok_s, 1),
+                 "shed_rate": round(sheds / len(prompts), 3),
+                 "ttft_ms_p95": round(
+                     float(np.percentile(ttft, 95)) * 1e3, 1)}
+        per_engines[engines] = entry
+        log(f"fleet x{engines}: {tok_s:.0f} tok/s/chip aggregate, "
+            f"shed {entry['shed_rate'] * 100:.0f}% of "
+            f"{len(prompts)} burst submits, ttft p95 "
+            f"{entry['ttft_ms_p95']:.0f}ms")
+    result["engines"] = per_engines
+    # compact headline: the 4-engine aggregate + scaling vs 1 engine
+    one = per_engines[1]["tokens_per_sec_per_chip"]
+    result.update({
+        "tokens_per_sec_per_chip": per_engines[4]["tokens_per_sec_per_chip"],
+        "scaling_2e": round(
+            per_engines[2]["tokens_per_sec_per_chip"] / one, 2),
+        "scaling_4e": round(
+            per_engines[4]["tokens_per_sec_per_chip"] / one, 2),
+        "shed_rate": per_engines[4]["shed_rate"],
+        "ttft_ms_p95": per_engines[4]["ttft_ms_p95"],
+    })
+    return result
+
+
 def bench_roofline(jax, on_tpu: bool):
     """Per-executable roofline from XLA `cost_analysis` over measured
     wall time (observability.RooflineProfiler): realized MFU for the LM
@@ -1507,6 +1592,8 @@ _COMPACT_KEYS = {
                "kv_bytes_per_slot", "max_concurrent_slots_at_fixed_hbm",
                "prefix_hit_rate", "fused_tokens_per_sec_per_chip",
                "fused_vs_gather", "kv_read_bytes_per_token"),
+    "fleet": ("tokens_per_sec_per_chip", "scaling_2e", "scaling_4e",
+              "shed_rate", "ttft_ms_p95"),
     "host_sync": ("gib_per_sec",),
     "all_reduce": ("bus_bandwidth_gb_s",),
     "roofline": ("lm_mfu", "lm_tflops_per_sec",
@@ -1598,8 +1685,8 @@ def _persist_partial(extra: dict) -> None:
 _LEGS_FILTER = os.environ.get("FLASHY_TPU_BENCH_LEGS")
 LEG_ORDER = tuple(
     name for name in ("smoke", "mxu", "cifar", "lm", "attention", "zero",
-                      "pipeline", "ring", "gan", "decode", "roofline",
-                      "datapipe", "host_sync", "all_reduce")
+                      "pipeline", "ring", "gan", "decode", "fleet",
+                      "roofline", "datapipe", "host_sync", "all_reduce")
     if _LEGS_FILTER is None or name in _LEGS_FILTER.split(","))
 
 
@@ -1658,6 +1745,7 @@ def child_main() -> None:
         "pipeline": lambda: bench_pipeline(jax, on_tpu),
         "ring": lambda: bench_ring(jax, on_tpu),
         "decode": lambda: bench_decode(jax, on_tpu),
+        "fleet": lambda: bench_fleet(jax, on_tpu),
         "roofline": lambda: bench_roofline(jax, on_tpu),
         "gan": lambda: bench_gan(jax, on_tpu),
         "datapipe": lambda: bench_datapipe(jax, on_tpu),

@@ -11,7 +11,8 @@ def collect(root: Path):
     """Yield {sig, cfg, argv, history, telemetry, serve, checkpoint} per
     XP under root."""
     from .solver import CHECKPOINT_META_NAME
-    from .xp import (CONFIG_SNAPSHOT_NAME, HEARTBEAT_DIR_NAME, RUN_INFO_NAME,
+    from .xp import (CONFIG_SNAPSHOT_NAME, FLEET_STATUS_NAME,
+                     HEARTBEAT_DIR_NAME, RUN_INFO_NAME,
                      SERVE_STATUS_NAME, Link)
     from .observability import straggler_report
 
@@ -22,7 +23,8 @@ def collect(root: Path):
         if not folder.is_dir():
             continue
         entry = {"sig": folder.name, "cfg": {}, "argv": [], "history": [],
-                 "telemetry": {}, "serve": {}, "checkpoint": {}}
+                 "telemetry": {}, "serve": {}, "fleet": {},
+                 "checkpoint": {}}
         meta_path = folder / CHECKPOINT_META_NAME
         if meta_path.exists():
             with open(meta_path) as f:
@@ -43,6 +45,10 @@ def collect(root: Path):
         if serve_path.exists():
             with open(serve_path) as f:
                 entry["serve"] = json.load(f)
+        fleet_path = folder / FLEET_STATUS_NAME
+        if fleet_path.exists():
+            with open(fleet_path) as f:
+                entry["fleet"] = json.load(f)
         yield entry
 
 
@@ -67,6 +73,8 @@ def format_entry(entry, verbose: bool = False) -> str:
         line += "\n  heartbeats: " + format_straggler_report(entry["telemetry"])
     if entry.get("serve"):
         line += "\n  serve: " + format_serve_status(entry["serve"])
+    if entry.get("fleet"):
+        line += "\n  fleet: " + format_fleet_status(entry["fleet"])
     if entry.get("checkpoint"):
         line += "\n  checkpoint: " + format_checkpoint_meta(entry["checkpoint"])
     if verbose:
@@ -121,6 +129,45 @@ def format_serve_status(status: dict) -> str:
     if "prefix_hit_rate" in status:
         parts.append(f"prefix_hit={status['prefix_hit_rate'] * 100:.0f}%")
     return "  ".join(parts) or "(empty serve.json)"
+
+
+def format_fleet_status(status: dict) -> str:
+    """Topology view of a `fleet.json` snapshot (flashy_tpu.serve.fleet).
+
+    One line per engine — role, health, slot/pool occupancy, how many
+    requests the router sent it, and any burning SLO budgets — plus a
+    fleet-level headline (policy, re-routes, deaths, tenant sheds).
+    Unknown keys are ignored so the snapshot schema can grow.
+    """
+    head = [f"policy={status.get('policy', '?')}"]
+    if status.get("reroutes"):
+        head.append(f"reroutes={int(status['reroutes'])}")
+    if status.get("deaths"):
+        head.append("deaths[" + ",".join(status["deaths"]) + "]")
+    shed = sum(t.get("shed", 0)
+               for t in status.get("tenants", {}).values())
+    if shed:
+        head.append(f"shed={shed}")
+    lines = ["  ".join(head)]
+    for name, engine in status.get("engines", {}).items():
+        parts = [f"{name}[{engine.get('role', '?')}]",
+                 "up" if engine.get("healthy") else "DEAD"]
+        if "live" in engine and "slots" in engine:
+            parts.append(f"slots={int(engine['live'])}/"
+                         f"{int(engine['slots'])}")
+        if "pool_occupancy" in engine:
+            parts.append(f"pool={engine['pool_occupancy'] * 100:.0f}%")
+        if "routed" in engine:
+            parts.append(f"routed={int(engine['routed'])}")
+        if "prefix_hit_rate" in engine:
+            parts.append(
+                f"prefix_hit={engine['prefix_hit_rate'] * 100:.0f}%")
+        if engine.get("slo_alerting"):
+            parts.append("SLO-ALERT["
+                         + ",".join(engine["slo_alerting"]) + "]")
+        lines.append("    " + "  ".join(parts))
+    return "\n".join(lines) if len(lines) > 1 else (
+        lines[0] + "  (no engines)")
 
 
 def format_checkpoint_meta(meta: dict) -> str:

@@ -35,7 +35,7 @@ from .heartbeat import (  # noqa
 )
 from .slo import (  # noqa
     COUNTER_SLO_BURN, DEFAULT_SLO_BUDGETS, SLOBudget, SLOEngine,
-    format_slo_report,
+    engine_budget_sets, format_slo_report,
 )
 from .roofline import RooflineProfiler, device_peaks  # noqa
 from .telemetry import (  # noqa
@@ -50,7 +50,7 @@ __all__ = [
     "device_memory_stats", "read_heartbeats", "straggler_report",
     "format_straggler_report",
     "SLOBudget", "SLOEngine", "DEFAULT_SLO_BUDGETS", "format_slo_report",
-    "COUNTER_SLO_BURN",
+    "engine_budget_sets", "COUNTER_SLO_BURN",
     "RooflineProfiler", "device_peaks",
     "TELEMETRY_NAME", "TRACE_NAME", "HEARTBEAT_DIR_NAME",
 ]

@@ -207,6 +207,28 @@ class SLOEngine:
         return report
 
 
+def engine_budget_sets(
+        names: tp.Sequence[str],
+        budgets: tp.Sequence[SLOBudget] = DEFAULT_SLO_BUDGETS,
+        **engine_kwargs: tp.Any) -> tp.Dict[str, SLOEngine]:
+    """One independent `SLOEngine` per fleet engine, all over the same
+    declarative budget set.
+
+    A fleet router sheds/redirects per ENGINE — a shared sample pool
+    would let a healthy engine's samples mask a burning one, so each
+    engine gets its own rolling windows (the frozen `SLOBudget`s
+    themselves are safely shared). `engine_kwargs` (fast_window,
+    burn_threshold, tracer, ...) pass through to every `SLOEngine`.
+    """
+    names = list(names)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate engine names in {names}")
+    if not names:
+        raise ValueError("need at least one engine name")
+    return {name: SLOEngine(budgets=budgets, **engine_kwargs)
+            for name in names}
+
+
 def format_slo_report(report: tp.Dict[str, tp.Any]) -> str:
     """Multi-line budget/burn table of an `SLOEngine.evaluate()` report
     (also accepts the `slo` block of a serve.json snapshot)."""

@@ -171,6 +171,23 @@ class DecodeEngine:
             private watchdog so recompile accounting always works.
             Only share a cache between engines whose `cache_scope`s
             differ.
+        pool: an existing `BlockPool` to SHARE with other engines
+            (paged only) — the disaggregated-serving seam: a prefill-
+            role engine fills blocks, then hands the slot off to a
+            decode-role engine as a block id list
+            (`serve.fleet.handoff`). Its `block_size`, `max_seq_len`
+            and (when `spec_k` is set) `spec_overshoot` must cover this
+            engine's shapes. By default each engine builds a private
+            pool.
+        cache_box: a `serve.paged.CacheBox` holding the device pool
+            pytree to share between engines over one `pool` (paged
+            only). An empty box is filled by this engine; co-resident
+            engines then read/write the SAME blocks through their own
+            tables. Requires `pool` to be shared too.
+        pool_slot_base: offset added to this engine's slot ids when
+            keying `pool` reservations. Engines sharing one pool MUST
+            use disjoint `[base, base + slots)` ranges — otherwise two
+            engines' slot 0 would collide on one reservation key.
     """
 
     def __init__(self, model, params, *, slots: int,
@@ -190,7 +207,10 @@ class DecodeEngine:
                  prefix_cache: bool = True,
                  cache_scope: str = "",
                  compile_cache: tp.Optional[CompileCache] = None,
-                 tracer: tp.Optional[Tracer] = None):
+                 tracer: tp.Optional[Tracer] = None,
+                 pool: tp.Optional[tp.Any] = None,
+                 cache_box: tp.Optional[tp.Any] = None,
+                 pool_slot_base: int = 0):
         import jax
         import jax.numpy as jnp
         from ..models.decoding import init_cache
@@ -290,21 +310,63 @@ class DecodeEngine:
         # dropped (mode="drop" in the dense cache scatter; clamped into
         # the sentinel block in the paged layout), so a freed slot can
         # never corrupt a neighbour.
+        if pool_slot_base < 0:
+            raise ValueError(f"pool_slot_base must be >= 0, "
+                             f"got {pool_slot_base}")
+        if cache_layout != "paged" and (pool is not None
+                                        or cache_box is not None
+                                        or pool_slot_base):
+            raise ValueError("pool / cache_box / pool_slot_base are "
+                             "paged-layout sharing hooks; the dense "
+                             "layout has no block pool to share")
+        self._pool_base = int(pool_slot_base)
         if cache_layout == "paged":
             from ..ops.paged_attention import block_bytes, init_pool
-            from .paged import BlockPool
-            if num_blocks is None:
-                # worst case: every slot reserves its full budget
-                num_blocks = 1 + slots * (self.max_seq_len
-                                          // self.block_size)
-            self.num_blocks = int(num_blocks)
-            self._pool = BlockPool(
-                num_blocks=self.num_blocks, block_size=self.block_size,
-                max_seq_len=self.max_seq_len,
-                spec_overshoot=self.spec_k or 0,
-                prefix_cache=prefix_cache)
-            self._cache = init_pool(self._cfg, self.num_blocks,
-                                    self.block_size, self.kv_dtype)
+            from .paged import BlockPool, CacheBox
+            if pool is not None:
+                if pool.block_size != self.block_size:
+                    raise ValueError(
+                        f"shared pool has block_size {pool.block_size}, "
+                        f"engine wants {self.block_size}")
+                if pool.max_seq_len != self.max_seq_len:
+                    raise ValueError(
+                        f"shared pool has max_seq_len {pool.max_seq_len}, "
+                        f"engine wants {self.max_seq_len} — table widths "
+                        f"would disagree")
+                if self.spec_k and pool.spec_overshoot < self.spec_k:
+                    raise ValueError(
+                        f"shared pool reserves spec_overshoot="
+                        f"{pool.spec_overshoot} < this engine's spec_k="
+                        f"{self.spec_k}: verify writes would overrun "
+                        f"reservations")
+                if num_blocks is not None \
+                        and int(num_blocks) != pool.num_blocks:
+                    raise ValueError(
+                        f"num_blocks={num_blocks} contradicts the shared "
+                        f"pool's {pool.num_blocks}")
+                self._pool = pool
+                self.num_blocks = pool.num_blocks
+            else:
+                if cache_box is not None:
+                    raise ValueError("cache_box sharing requires a shared "
+                                     "pool (the box holds that pool's "
+                                     "device blocks)")
+                if num_blocks is None:
+                    # worst case: every slot reserves its full budget
+                    num_blocks = 1 + slots * (self.max_seq_len
+                                              // self.block_size)
+                self.num_blocks = int(num_blocks)
+                self._pool = BlockPool(
+                    num_blocks=self.num_blocks, block_size=self.block_size,
+                    max_seq_len=self.max_seq_len,
+                    spec_overshoot=self.spec_k or 0,
+                    prefix_cache=prefix_cache)
+            self._cache_box = cache_box if cache_box is not None \
+                else CacheBox()
+            if self._cache_box.value is None:
+                self._cache_box.value = init_pool(
+                    self._cfg, self.num_blocks, self.block_size,
+                    self.kv_dtype)
             self._block_bytes = block_bytes(self._cfg, self.block_size,
                                             self.kv_dtype)
             self._table_host = np.zeros(
@@ -312,9 +374,11 @@ class DecodeEngine:
             self._table_dev = jnp.asarray(self._table_host)
             self._table_dirty = False
         else:
+            from .paged import CacheBox
             self.num_blocks = 0
             self._pool = None
-            self._cache = init_cache(self._cfg, slots, self.max_seq_len)
+            self._cache_box = CacheBox(
+                init_cache(self._cfg, slots, self.max_seq_len))
         self._tokens = jnp.full((slots,), self.pad_token, jnp.int32)
         self._positions = jnp.full((slots,), self.max_seq_len, jnp.int32)
         self._active = jnp.zeros((slots,), bool)
@@ -337,6 +401,36 @@ class DecodeEngine:
         prefixed with `cache_scope` so co-resident engines (a draft
         mirror) never collide in a shared cache or watchdog."""
         return ((self.cache_scope,) if self.cache_scope else ()) + parts
+
+    @property
+    def _cache(self):
+        """The device cache pytree, read through the (possibly shared)
+        CacheBox so co-resident engines over one pool always see each
+        other's latest functional update."""
+        return self._cache_box.value
+
+    @_cache.setter
+    def _cache(self, value) -> None:
+        self._cache_box.value = value
+
+    @property
+    def pool(self):
+        """This engine's BlockPool (None on the dense layout); shared
+        with other engines when one was passed at construction."""
+        return self._pool
+
+    @property
+    def cache_box(self):
+        """The CacheBox holding the device cache pytree (share it with
+        a second paged engine over the same `pool` for disaggregated
+        prefill/decode handoff)."""
+        return self._cache_box
+
+    def pool_key(self, slot: int) -> int:
+        """The BlockPool reservation key for an engine slot:
+        `slot + pool_slot_base`. Engines sharing one pool keep disjoint
+        key ranges so their slot ids never collide on a reservation."""
+        return slot + self._pool_base
 
     def _sample(self, logits, key):
         """Next token from [S, V] logits (matches generate()'s rule)."""
@@ -691,7 +785,7 @@ class DecodeEngine:
             raise ValueError(f"slot {slot} was not acquired")
         prompt = np.asarray(prompt, np.int32)
         plan = self._pool.plan(prompt, max_new_tokens)
-        row, start, cow = self._pool.commit(plan, slot)
+        row, start, cow = self._pool.commit(plan, self.pool_key(slot))
         self._table_host[slot] = row
         self._table_dirty = True
         if cow is not None:
@@ -848,7 +942,7 @@ class DecodeEngine:
         if self._pool is not None:
             # prompt fully written: index its full blocks so later
             # admissions share them instead of re-prefilling
-            self._pool.on_live(slot)
+            self._pool.on_live(self.pool_key(slot))
         self._tokens = self._tokens.at[slot].set(first)
         self._positions = self._positions.at[slot].set(length)
         self._active = self._active.at[slot].set(True)
@@ -947,11 +1041,113 @@ class DecodeEngine:
         self._tokens = self._tokens.at[slot].set(self.pad_token)
         self._positions_host[slot] = self.max_seq_len
         self._active_host[slot] = False
-        if self._pool is not None and self._pool.holds(slot):
-            self._pool.release(slot)
+        if self._pool is not None and self._pool.holds(self.pool_key(slot)):
+            self._pool.release(self.pool_key(slot))
             self._table_host[slot] = 0
             self._table_dirty = True
         self.allocator.release(slot)
+
+    def preempt_slot(self, slot: int) -> None:
+        """Tear a live slot down mid-decode so a higher-priority request
+        can take its capacity.
+
+        Same deactivation as `retire()` — the parked position makes any
+        pending write fall out of range — but the pool teardown goes
+        through `BlockPool.evict_slot`, which counts the preemption and
+        keeps the prompt's prefix-indexed blocks cached, so the
+        preempted request's eventual re-admission re-matches its own
+        prompt chain instead of re-prefilling it. Rollback needs no K/V
+        cleanup: rows the request wrote sit beyond every causal horizon
+        once the position parks, until some later reservation
+        overwrites them (the speculative-rejection argument).
+        """
+        if slot not in self.allocator.live:
+            raise ValueError(f"slot {slot} is not live")
+        self._active = self._active.at[slot].set(False)
+        self._positions = self._positions.at[slot].set(self.max_seq_len)
+        self._tokens = self._tokens.at[slot].set(self.pad_token)
+        self._positions_host[slot] = self.max_seq_len
+        self._active_host[slot] = False
+        if self._pool is not None and self._pool.holds(self.pool_key(slot)):
+            self._pool.evict_slot(self.pool_key(slot))
+            self._table_host[slot] = 0
+            self._table_dirty = True
+        self.allocator.release(slot)
+
+    def release_for_handoff(self, slot: int) -> tp.Dict[str, tp.Any]:
+        """Export a live slot's decode state and detach the slot WITHOUT
+        freeing its pool blocks (the prefill half of disaggregation).
+
+        Returns `{"blocks", "position", "last_token"}`: the ordered
+        pool block ids backing the slot's table, the next write
+        position (prompt + generated length), and the last emitted
+        token — everything a decode-role engine over the SAME pool and
+        CacheBox needs to continue the request token-exactly. The pool
+        reservation stays keyed to this engine's `pool_key(slot)` until
+        the importer re-keys it (`BlockPool.transfer_slot`); this slot
+        itself is deactivated and returned to the allocator. Paged
+        engines only.
+        """
+        if self._pool is None:
+            raise ValueError("handoff requires the paged layout: the "
+                             "transfer unit is a block id list")
+        if slot not in self.allocator.live or not self._active_host[slot]:
+            raise ValueError(f"slot {slot} is not live")
+        packet = {
+            "blocks": self._pool.slot_blocks(self.pool_key(slot)),
+            "position": int(self._positions_host[slot]),
+            "last_token": int(np.asarray(self._tokens)[slot]),
+        }
+        self._active = self._active.at[slot].set(False)
+        self._positions = self._positions.at[slot].set(self.max_seq_len)
+        self._tokens = self._tokens.at[slot].set(self.pad_token)
+        self._positions_host[slot] = self.max_seq_len
+        self._active_host[slot] = False
+        self._table_host[slot] = 0
+        self._table_dirty = True
+        self.allocator.release(slot)
+        return packet
+
+    def adopt_handoff(self, slot: int, blocks: tp.Sequence[int],
+                      last_token: int, position: int) -> None:
+        """Install an exported reservation into an acquired slot (the
+        decode half of disaggregation).
+
+        Fills the slot's table row with the handed-off block list and
+        arms the slot at (`last_token`, `position`) — the fused/gather
+        kernels read whatever table they are handed, so the next decode
+        step continues exactly where the prefill engine stopped. The
+        pool reservation must already be keyed to this engine's
+        `pool_key(slot)` via `BlockPool.transfer_slot` (the fleet's
+        `hand_off` does both halves in order). Token-exactness is the
+        purity argument: the blocks hold K/V rows that are pure
+        functions of (token, position, params), and this engine shares
+        all three.
+        """
+        if self._pool is None:
+            raise ValueError("handoff requires the paged layout")
+        if slot not in self.allocator.live:
+            raise ValueError(f"slot {slot} was not acquired")
+        if not self._pool.holds(self.pool_key(slot)):
+            raise ValueError(
+                f"pool holds no reservation keyed to {self.pool_key(slot)} "
+                f"— transfer_slot() must re-key the export first")
+        if not 0 < position <= self.max_seq_len:
+            raise ValueError(f"position {position} outside "
+                             f"(0, {self.max_seq_len}]")
+        blocks = list(blocks)
+        if len(blocks) > self._pool.max_blocks:
+            raise ValueError(f"{len(blocks)} blocks exceed the "
+                             f"{self._pool.max_blocks}-entry table")
+        row = np.zeros(self._pool.max_blocks, np.int32)  # sentinel-padded
+        row[:len(blocks)] = blocks
+        self._table_host[slot] = row
+        self._table_dirty = True
+        self._tokens = self._tokens.at[slot].set(int(last_token))
+        self._positions = self._positions.at[slot].set(int(position))
+        self._active = self._active.at[slot].set(True)
+        self._positions_host[slot] = int(position)
+        self._active_host[slot] = True
 
     def slot_length(self, slot: int) -> int:
         """Current sequence length of a live slot (prompt + generated).

@@ -1,0 +1,23 @@
+# Disaggregated, router-fronted serving fleet — the layer that turns
+# "an engine" (flashy_tpu.serve) into "a deployment": N engines behind
+# one deterministic prefix-sticky router, prefill/decode role
+# separation with block-list handoff over a shared pool, per-tenant
+# quotas + priority preemption, per-engine SLO-burn redirect, and an
+# engine-death drill that re-serves in-flight requests token-exactly.
+# Everything composes the existing engine/scheduler/paged machinery —
+# no compiled program changed to build it.
+"""Serving fleet: router, disaggregated handoff, quotas, deployment."""
+
+from .fleet import (  # noqa
+    ENGINE_FAULT_SITE, FleetMember, ServingFleet,
+)
+from .handoff import DisaggregatedPair, HandoffPacket, hand_off  # noqa
+from .quota import QuotaManager, TenantQuota  # noqa
+from .router import FleetRouter, RouteDecision, fnv1a  # noqa
+
+__all__ = [
+    "ServingFleet", "FleetMember", "ENGINE_FAULT_SITE",
+    "DisaggregatedPair", "HandoffPacket", "hand_off",
+    "QuotaManager", "TenantQuota",
+    "FleetRouter", "RouteDecision", "fnv1a",
+]

@@ -1,0 +1,507 @@
+# Fleet acceptance demo — `python -m flashy_tpu.serve.fleet`
+# (`make fleet-demo`). Four legs, each an exit gate from the fleet
+# design:
+#
+#   handoff   disaggregated prefill->decode over one shared pool is
+#             token-exact vs per-request generate() on mixed-length
+#             concurrent requests, zero post-warm-up compiles on BOTH
+#             engines, pool conservation holds
+#   sticky    deterministic prefix-sticky routing beats (>=) the
+#             round-robin baseline's prefix-cache hit rate on a
+#             shared-system-prompt workload, and the same (uid, chain
+#             key, fleet) routes identically on a fresh router
+#   preempt   a high-priority tenant preempts low-priority running
+#             requests; every victim completes token-exactly after
+#             re-queue, per-tenant rollups land in serve.json, pool
+#             conservation holds throughout
+#   drill     a strict fault injector kills an engine mid-decode at
+#             the `fleet.engine_step` site; the router re-routes every
+#             in-flight request and ALL of them re-serve token-exactly
+#             (re-prefill from the retained prompt+generated), with
+#             the armed fault verified fired and fleet.json recording
+#             the death
+#
+# Everything runs on CPU with a tiny model: the gates are about
+# protocol correctness (block-list handoff, preemption rollback,
+# deterministic re-route), which does not need a big model to break.
+"""Serving-fleet smoke demo: handoff, sticky routing, preempt, drill."""
+import argparse
+import logging
+import sys
+import typing as tp
+
+logger = logging.getLogger(__name__)
+
+LEGS = ("handoff", "sticky", "preempt", "drill")
+
+
+def _fleet_mix(n: int, vocab: int, seed: int, shared: int = 16,
+               share_every: int = 2):
+    """`n` prompts where every `share_every`-th shares a `shared`-token
+    system prefix (one full 16-block: the routing chain key and the
+    prefix-cache hit unit are both that first block)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    system = rng.integers(0, vocab, shared).astype(np.int32)
+    prompts = []
+    for i in range(n):
+        tail = rng.integers(0, vocab, int(rng.integers(3, 9)))
+        tail = tail.astype(np.int32)
+        if i % share_every == 0:
+            prompts.append(np.concatenate([system, tail]))
+        else:
+            prompts.append(tail)
+    return prompts
+
+
+def _post_warm(engine, warm: tp.Dict[str, int]) -> tp.Tuple[int, int]:
+    """(post-warm-up builds, recompiles) for one engine."""
+    stats = engine.compile_cache.stats()
+    return stats["misses"] - warm["misses"], stats["recompiles"]
+
+
+def run_handoff_demo(requests: int = 8, seed: int = 0,
+                     kernel: str = "gather",
+                     log: tp.Optional[logging.Logger] = None) -> int:
+    """Gate: disaggregated serving is invisible in the tokens.
+
+    Mixed-length prompts go prefill-engine -> block-list handoff ->
+    decode-engine (one shared `BlockPool` + `CacheBox`, disjoint slot
+    key ranges) and every output must equal per-request `generate()`.
+    Also gates: one handoff per multi-token request, zero post-warm-up
+    compiles on each engine (distinct `cache_scope`s), and pool
+    conservation after the run.
+    """
+    import numpy as np
+    from ..__main__ import _build_model
+    from ...models.decoding import generate
+    from .handoff import DisaggregatedPair
+
+    log = log or logger
+    vocab = 64
+    model, params = _build_model(vocab, seed)
+    prompts = _fleet_mix(requests, vocab, seed + 1)
+    max_new = 6
+
+    pair = DisaggregatedPair(model, params, prefill_slots=2,
+                             decode_slots=4, block_size=16,
+                             kernel=kernel)
+    log.info("handoff leg: warming prefill(2 slots) + decode(4 slots) "
+             "over one %d-block shared pool...",
+             pair.pool.stats()["capacity"])
+    pair.warmup(prompt_lengths=[len(p) for p in prompts])
+    warm = {"prefill": dict(pair.prefill.compile_cache.stats()),
+            "decode": dict(pair.decode.compile_cache.stats())}
+
+    outputs = pair.serve(prompts, max_new)
+
+    failures = 0
+    mismatches = 0
+    for prompt, out in zip(prompts, outputs):
+        # generate() returns prompt + generated; serve() returns the
+        # generated tokens only
+        want = np.asarray(generate(model, params, prompt[None],
+                                   max_new_tokens=max_new))[0]
+        got = np.concatenate([prompt, np.asarray(out, np.int32)])
+        if not np.array_equal(got, want):
+            mismatches += 1
+            log.error("handoff output diverged from generate():\n"
+                      "  served   %s\n  generate %s", out, want.tolist())
+    if mismatches:
+        failures += 1
+    else:
+        log.info("verified: all %d disaggregated outputs token-exact "
+                 "against per-request generate()", len(prompts))
+    if len(pair.handoffs) != len(prompts):
+        log.error("expected one handoff per request, got %d for %d",
+                  len(pair.handoffs), len(prompts))
+        failures += 1
+    else:
+        log.info("handoffs: %d block-list packets crossed the "
+                 "prefill->decode boundary (largest %d blocks)",
+                 len(pair.handoffs),
+                 max(len(p.blocks) for p in pair.handoffs))
+    for role, engine in (("prefill", pair.prefill),
+                         ("decode", pair.decode)):
+        builds, recompiles = _post_warm(engine, warm[role])
+        if builds or recompiles:
+            log.error("%s engine was not compile-free post warm-up: "
+                      "%d builds, %d recompiles", role, builds,
+                      recompiles)
+            failures += 1
+    try:
+        pair.pool.check()
+    except AssertionError as exc:
+        log.error("pool conservation violated after handoffs: %s", exc)
+        failures += 1
+    stats = pair.pool.stats()
+    log.info("shared pool after run: %d/%d blocks free, %d handoffs "
+             "re-keyed, conservation ok", stats["free"],
+             stats["capacity"], stats["handoffs"])
+    return 1 if failures else 0
+
+
+def run_sticky_demo(requests: int = 24, engines: int = 3,
+                    slots: int = 4, seed: int = 0,
+                    kernel: str = "gather",
+                    log: tp.Optional[logging.Logger] = None) -> int:
+    """Gate: sticky routing earns its keep AND is replayable.
+
+    Serves the shared-system-prompt workload through two otherwise
+    identical fleets — `policy="sticky"` vs `policy="round_robin"` —
+    and requires the sticky fleet's aggregate prefix-cache hit rate to
+    be >= round-robin's (stickiness concentrates a shared prefix on
+    one member, so its PrefixIndex actually gets hits). Determinism:
+    a fresh `FleetRouter` replays every (uid, prompt) to the identical
+    member. Both fleets must be token-exact and compile-free.
+    """
+    import numpy as np
+    from ..__main__ import _build_model
+    from ...models.decoding import generate
+    from .fleet import ServingFleet
+    from .quota import QuotaManager, TenantQuota
+    from .router import FleetRouter
+
+    log = log or logger
+    vocab = 64
+    model, params = _build_model(vocab, seed)
+    prompts = _fleet_mix(requests, vocab, seed + 1)
+    max_new = 5
+    failures = 0
+    hit_rates = {}
+
+    for policy in ("round_robin", "sticky"):
+        fleet = ServingFleet.build(
+            model, params, engines=engines, slots=slots, block_size=16,
+            kernel=kernel, policy=policy,
+            quotas=QuotaManager(default=TenantQuota(
+                max_inflight=max(requests, 1))))
+        fleet.warmup(prompt_lengths=[len(p) for p in prompts])
+        warm = {n: dict(m.engine.compile_cache.stats())
+                for n, m in fleet.members.items()}
+        handles = [fleet.submit(p, max_new) for p in prompts]
+        routes = [fleet._inflight[h.uid][2] for h in handles]
+        fleet.run()
+
+        for prompt, handle in zip(prompts, handles):
+            want = np.asarray(generate(model, params, prompt[None],
+                                       max_new_tokens=max_new))[0]
+            if not np.array_equal(handle.output, want):
+                log.error("[%s] request %d diverged from generate()",
+                          policy, handle.uid)
+                failures += 1
+        for name, member in fleet.members.items():
+            builds, recompiles = _post_warm(member.engine, warm[name])
+            if builds or recompiles:
+                log.error("[%s] %s not compile-free: %d builds, "
+                          "%d recompiles", policy, name, builds,
+                          recompiles)
+                failures += 1
+        pools = [m.engine.pool for m in fleet.members.values()]
+        hits = sum(p.prefix_matched_tokens for p in pools)
+        total = sum(p.prefix_total_tokens for p in pools)
+        hit_rates[policy] = hits / max(total, 1)
+        log.info("[%s] routed %s; aggregate prefix hit rate %.3f "
+                 "(%d/%d prompt tokens from the index)", policy,
+                 dict(sorted(fleet.engine_routed.items())),
+                 hit_rates[policy], hits, total)
+
+        if policy == "sticky":
+            # determinism: a FRESH router (new process stands in as a
+            # new object — fnv1a has no per-process salt) must replay
+            # every decision identically.
+            replay = FleetRouter(list(fleet.members),
+                                 block_size=16, policy="sticky")
+            replayed = [replay.route(uid, p).engine
+                        for uid, p in enumerate(prompts)]
+            if replayed != routes:
+                log.error("sticky routing is not replayable: %s vs %s",
+                          replayed, routes)
+                failures += 1
+            else:
+                log.info("determinism: a fresh router replayed all %d "
+                         "decisions identically", len(routes))
+
+    if hit_rates["sticky"] < hit_rates["round_robin"]:
+        log.error("sticky prefix hit rate %.3f lost to round-robin "
+                  "%.3f on a shared-prefix workload", hit_rates["sticky"],
+                  hit_rates["round_robin"])
+        failures += 1
+    else:
+        log.info("verified: sticky %.3f >= round_robin %.3f prefix "
+                 "hit rate", hit_rates["sticky"],
+                 hit_rates["round_robin"])
+    return 1 if failures else 0
+
+
+def run_preempt_demo(low: int = 4, slots: int = 2, seed: int = 0,
+                     kernel: str = "gather",
+                     log: tp.Optional[logging.Logger] = None) -> int:
+    """Gate: preemption's rollback is invisible in the tokens.
+
+    A batch tenant (priority 0) fills a 1-engine fleet; an interactive
+    tenant (priority 5) then submits and must preempt a running batch
+    request (blocks evicted via `BlockPool.evict_slot`, request
+    re-queued with its generated tokens retained). Every request —
+    victims included — must finish token-exact vs `generate()`; pool
+    conservation is checked after every fleet step; the per-tenant
+    rollups (requests/tokens/preempted) must land in serve.json.
+    """
+    import json
+    import tempfile
+    import numpy as np
+    from pathlib import Path
+
+    from ..__main__ import _build_model
+    from ...models.decoding import generate
+    from ...xp import SERVE_STATUS_NAME
+    from .fleet import ServingFleet
+    from .quota import QuotaManager, TenantQuota
+
+    log = log or logger
+    vocab = 64
+    model, params = _build_model(vocab, seed)
+    rng = np.random.default_rng(seed + 1)
+    low_prompts = [rng.integers(0, vocab, 5 + i).astype(np.int32)
+                   for i in range(low)]
+    hi_prompt = rng.integers(0, vocab, 6).astype(np.int32)
+
+    quotas = QuotaManager({
+        "batch": TenantQuota(max_inflight=2 * low, priority=0),
+        "interactive": TenantQuota(max_inflight=4, priority=5)})
+    fleet = ServingFleet.build(model, params, engines=1, slots=slots,
+                               block_size=16, kernel=kernel,
+                               quotas=quotas)
+    lengths = [len(p) for p in low_prompts] + [len(hi_prompt)]
+    fleet.warmup(prompt_lengths=lengths)
+    member = next(iter(fleet.members.values()))
+    warm = dict(member.engine.compile_cache.stats())
+
+    failures = 0
+    low_handles = [fleet.submit(p, 12, tenant="batch")
+                   for p in low_prompts]
+    for _ in range(3):  # let the batch requests get decoding
+        fleet.step()
+        member.engine.pool.check()
+    hi_handle = fleet.submit(hi_prompt, 8, tenant="interactive")
+    while not all(h.done for h in low_handles + [hi_handle]):
+        fleet.step()
+        member.engine.pool.check()
+    fleet.run()  # drain bookkeeping (quota reap)
+
+    preemptions = sum(h.preemptions for h in low_handles)
+    pool_evictions = member.engine.pool.stats()["preemptions"]
+    if preemptions < 1 or pool_evictions < 1:
+        log.error("the interactive tenant never preempted anyone "
+                  "(request preemptions %d, pool evictions %d) — the "
+                  "gate needs the rollback path exercised", preemptions,
+                  pool_evictions)
+        failures += 1
+    else:
+        log.info("preempted %d batch request(s) (%d slot evictions); "
+                 "victims re-queued with generated tokens retained",
+                 preemptions, pool_evictions)
+    for prompt, handle in zip(low_prompts + [hi_prompt],
+                              low_handles + [hi_handle]):
+        want = np.asarray(generate(model, params, prompt[None],
+                                   max_new_tokens=handle.max_new_tokens))[0]
+        if not np.array_equal(handle.output, want):
+            log.error("request %d (%d preemptions) diverged from "
+                      "generate():\n  served   %s\n  generate %s",
+                      handle.uid, handle.preemptions,
+                      handle.output.tolist(), want.tolist())
+            failures += 1
+    if not failures:
+        log.info("verified: all %d outputs token-exact, preempted "
+                 "victims included", low + 1)
+    builds, recompiles = _post_warm(member.engine, warm)
+    if builds or recompiles:
+        log.error("preemption was not compile-free: %d builds, %d "
+                  "recompiles (rollback must be a data change, never "
+                  "a shape change)", builds, recompiles)
+        failures += 1
+
+    with tempfile.TemporaryDirectory() as tmp:
+        member.scheduler.metrics.write_status(tmp)
+        with open(Path(tmp) / SERVE_STATUS_NAME) as f:
+            status = json.load(f)
+    tenants = status.get("tenants", {})
+    if set(tenants) != {"batch", "interactive"} \
+            or tenants.get("batch", {}).get("preempted", 0) < 1:
+        log.error("serve.json per-tenant rollups are wrong: %s", tenants)
+        failures += 1
+    else:
+        log.info("serve.json tenants block: %s", tenants)
+    return 1 if failures else 0
+
+
+def run_drill_demo(requests: int = 8, engines: int = 2, slots: int = 4,
+                   seed: int = 0, kernel: str = "gather",
+                   log: tp.Optional[logging.Logger] = None) -> int:
+    """Gate: an engine death loses no request and no tokens.
+
+    Submits `requests` to a fleet, steps until several are mid-decode,
+    then a STRICT injector kills one engine at the `fleet.engine_step`
+    fault site. The router must re-route every in-flight request to
+    the survivors (re-prefill from retained prompt+generated) and ALL
+    outputs must equal per-request `generate()`. Strictness: the
+    drill fails if the armed fault never fires. fleet.json must
+    record the death and the surviving topology.
+    """
+    import json
+    import tempfile
+    import numpy as np
+    from pathlib import Path
+
+    from ..__main__ import _build_model
+    from ...models.decoding import generate
+    from ...resilience import chaos
+    from ...xp import FLEET_STATUS_NAME
+    from .fleet import ENGINE_FAULT_SITE, ServingFleet
+    from .quota import QuotaManager, TenantQuota
+
+    log = log or logger
+    vocab = 64
+    model, params = _build_model(vocab, seed)
+    prompts = _fleet_mix(requests, vocab, seed + 1)
+    max_new = 6
+
+    fleet = ServingFleet.build(
+        model, params, engines=engines, slots=slots, block_size=16,
+        kernel=kernel,
+        quotas=QuotaManager(default=TenantQuota(
+            max_inflight=max(requests, 1))))
+    fleet.warmup(prompt_lengths=[len(p) for p in prompts])
+    warm = {n: dict(m.engine.compile_cache.stats())
+            for n, m in fleet.members.items()}
+
+    failures = 0
+    handles = [fleet.submit(p, max_new) for p in prompts]
+    for _ in range(2):  # get requests mid-decode before the kill
+        fleet.step()
+    victim = fleet.healthy[0]
+    mid_flight = fleet.members[victim].scheduler.live_count
+    log.info("drill: killing %s mid-decode (%d live requests on it) "
+             "via strict %s injection...", victim, mid_flight,
+             ENGINE_FAULT_SITE)
+    if mid_flight < 1:
+        log.error("drill is vacuous: no live requests on %s at kill "
+                  "time", victim)
+        failures += 1
+
+    injector = chaos.install(strict=True)
+    # the victim's fault point is the FIRST site occurrence after
+    # install (members are stepped in name order, victim is first).
+    injector.fail_at(ENGINE_FAULT_SITE, call=1)
+    try:
+        fleet.run()
+    finally:
+        # strict: raises UnfiredFaultRules if the kill never happened
+        chaos.uninstall()
+
+    if fleet.deaths != [victim] or injector.hits(ENGINE_FAULT_SITE) != 1:
+        log.error("expected exactly one injected death of %s, got "
+                  "deaths=%s hits=%d", victim, fleet.deaths,
+                  injector.hits(ENGINE_FAULT_SITE))
+        failures += 1
+    if fleet.reroutes < mid_flight:
+        log.error("only %d re-routes for %d in-flight requests on the "
+                  "dead engine", fleet.reroutes, mid_flight)
+        failures += 1
+    mismatches = 0
+    for prompt, handle in zip(prompts, handles):
+        want = np.asarray(generate(model, params, prompt[None],
+                                   max_new_tokens=max_new))[0]
+        if not handle.done or not np.array_equal(handle.output, want):
+            mismatches += 1
+            log.error("request %d was not re-served token-exactly "
+                      "(done=%s)", handle.uid, handle.done)
+    if mismatches:
+        failures += 1
+    else:
+        log.info("verified: every request re-served token-exactly "
+                 "after the death (%d re-routed mid-flight)",
+                 fleet.reroutes)
+    for name, member in fleet.members.items():
+        if not member.healthy:
+            continue
+        builds, recompiles = _post_warm(member.engine, warm[name])
+        if builds or recompiles:
+            log.error("survivor %s not compile-free after absorbing "
+                      "re-routes: %d builds, %d recompiles", name,
+                      builds, recompiles)
+            failures += 1
+        try:
+            member.engine.pool.check()
+        except AssertionError as exc:
+            log.error("survivor %s pool conservation violated: %s",
+                      name, exc)
+            failures += 1
+
+    with tempfile.TemporaryDirectory() as tmp:
+        fleet.write_status(tmp)
+        with open(Path(tmp) / FLEET_STATUS_NAME) as f:
+            status = json.load(f)
+    if status["deaths"] != [victim] \
+            or status["engines"][victim]["healthy"] \
+            or not all(status["engines"][n]["healthy"]
+                       for n in fleet.healthy):
+        log.error("fleet.json does not record the death: %s",
+                  {n: e["healthy"]
+                   for n, e in status["engines"].items()})
+        failures += 1
+    else:
+        log.info("fleet.json: %d engines, deaths=%s, reroutes=%d",
+                 len(status["engines"]), status["deaths"],
+                 status["reroutes"])
+    return 1 if failures else 0
+
+
+def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flashy_tpu.serve.fleet",
+        description="Serving-fleet smoke demo (CPU): disaggregated "
+                    "handoff, sticky routing, preemption, death drill.")
+    parser.add_argument("-n", "--requests", type=int, default=8)
+    parser.add_argument("-e", "--engines", type=int, default=2)
+    parser.add_argument("-s", "--slots", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--legs", default="all",
+                        help="comma list of legs to run: "
+                             f"{','.join(LEGS)} (or 'all')")
+    parser.add_argument("--kernel", default="gather",
+                        choices=("gather", "fused"),
+                        help="paged pool read path (the gather "
+                             "reference is the default here: the fleet "
+                             "gates are protocol gates, the fused "
+                             "kernel has its own in the paged demo)")
+    args = parser.parse_args(argv)
+
+    legs = LEGS if args.legs == "all" else tuple(args.legs.split(","))
+    unknown = set(legs) - set(LEGS)
+    if unknown:
+        parser.error(f"unknown legs: {sorted(unknown)} (choose from {LEGS})")
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="[%(levelname)s] %(message)s")
+    rc = 0
+    if "handoff" in legs:
+        rc |= run_handoff_demo(requests=args.requests, seed=args.seed,
+                               kernel=args.kernel)
+    if "sticky" in legs:
+        rc |= run_sticky_demo(requests=max(12, 3 * args.requests),
+                              engines=max(3, args.engines),
+                              slots=args.slots, seed=args.seed,
+                              kernel=args.kernel)
+    if "preempt" in legs:
+        rc |= run_preempt_demo(low=4, slots=2, seed=args.seed,
+                               kernel=args.kernel)
+    if "drill" in legs:
+        rc |= run_drill_demo(requests=args.requests,
+                             engines=args.engines, slots=args.slots,
+                             seed=args.seed, kernel=args.kernel)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

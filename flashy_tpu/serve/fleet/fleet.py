@@ -1,0 +1,342 @@
+# The deployment object. ROADMAP item 1's north star is
+# millions-of-users serving; a single DecodeEngine is a building, not a
+# city. ServingFleet composes N independent engine+scheduler members
+# (each with its own block pool, compile cache scope and SLO budget
+# windows) behind one FleetRouter and one QuotaManager: submit routes
+# by prefix chain key, quotas shed noisy tenants at the door, per-
+# engine burn rates redirect traffic away from burning members, and an
+# engine death (the `fleet.engine_step` fault site) drains the dead
+# member's in-flight requests and re-routes them to the survivors —
+# re-prefilling each retained prompt+generated, which re-derives the
+# lost K/V exactly (purity), so re-served output is token-identical.
+# The host loop stays sequential: one fleet.step() steps every healthy
+# member once, so all the single-engine invariants (ONE executable per
+# shape, host-exact position mirrors) survive unchanged.
+"""ServingFleet: router-fronted multi-engine serving deployment."""
+import itertools
+import json
+import logging
+import typing as tp
+from pathlib import Path
+
+from ...observability.slo import SLOEngine
+from ...resilience import InjectedFault, fault_point
+from ...utils import write_and_rename
+from ...xp import FLEET_STATUS_NAME, AnyPath
+from ..metrics import ServeMetrics
+from ..scheduler import ContinuousBatchingScheduler, QueueFull, Request
+from .quota import QuotaManager
+from .router import FleetRouter
+
+logger = logging.getLogger(__name__)
+
+# Consulted once per healthy engine per fleet step; the chaos drill
+# arms a strict injector here (ctx carries engine=<name>) to kill a
+# member mid-decode and prove the router re-serves its requests.
+ENGINE_FAULT_SITE = "fleet.engine_step"
+
+
+class FleetMember:
+    """One engine seat in the fleet: name, role, scheduler, SLO."""
+
+    def __init__(self, name: str, scheduler: ContinuousBatchingScheduler,
+                 slo: tp.Optional[SLOEngine] = None, role: str = "both"):
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, "
+                             f"got {role!r}")
+        self.name = name
+        self.role = role
+        self.scheduler = scheduler
+        self.slo = slo
+        self.healthy = True
+
+    @property
+    def engine(self):
+        return self.scheduler.engine
+
+
+class ServingFleet:
+    """N engines, one front door.
+
+    `submit()` = quota check -> SLO-aware route -> member scheduler
+    queue; `step()` = one scheduler step on every healthy member (with
+    the `fleet.engine_step` fault site consulted first — an injected
+    fault there IS an engine death: the member is marked dead, its
+    in-flight requests drain and re-route to survivors). Requests keep
+    their fleet-unique uid through any number of re-routes; routing is
+    deterministic, so a drill is replayable.
+
+    Args:
+        members: the engine seats, in router order.
+        router: a FleetRouter over the member names (one is built with
+            `policy` over the first member's block size by default).
+        quotas: a QuotaManager; by default every tenant gets the
+            default quota.
+        policy: routing policy for the default router.
+        tracing: optional `RequestTracer` shared by every member
+            scheduler (uids are fleet-unique, so one journal serves
+            all); pass at `build()` time to wire it through.
+    """
+
+    def __init__(self, members: tp.Sequence[FleetMember],
+                 router: tp.Optional[FleetRouter] = None,
+                 quotas: tp.Optional[QuotaManager] = None,
+                 policy: str = "sticky",
+                 tracing: tp.Optional[tp.Any] = None):
+        members = list(members)
+        if not members:
+            raise ValueError("a fleet needs at least one member")
+        names = [m.name for m in members]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate member names: {names}")
+        self.members: tp.Dict[str, FleetMember] = {m.name: m
+                                                   for m in members}
+        if router is None:
+            block_size = members[0].engine.block_size
+            router = FleetRouter(names, block_size=block_size,
+                                 policy=policy)
+        if list(router.engines) != names:
+            raise ValueError(
+                f"router engines {router.engines} must match the member "
+                f"names {names} (order included — it is part of the "
+                f"deterministic routing contract)")
+        self.router = router
+        self.quotas = quotas or QuotaManager()
+        self.tracing = tracing
+        # uid -> (request, tenant, member name); reaped as they finish
+        self._inflight: tp.Dict[int, tp.List[tp.Any]] = {}
+        self._route_seq = 0  # round-robin clock (== submit attempts)
+        self.route_reasons: tp.Dict[str, int] = {}
+        self.engine_routed: tp.Dict[str, int] = {n: 0 for n in names}
+        self.reroutes = 0
+        self.deaths: tp.List[str] = []
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    @classmethod
+    def build(cls, model, params, *, engines: int = 2, slots: int = 4,
+              max_queue: int = 128,
+              policy: str = "sticky",
+              quotas: tp.Optional[QuotaManager] = None,
+              slo_budgets: tp.Optional[tp.Sequence[tp.Any]] = None,
+              slo_kwargs: tp.Optional[tp.Dict[str, tp.Any]] = None,
+              tracing: tp.Optional[tp.Any] = None,
+              names: tp.Optional[tp.Sequence[str]] = None,
+              **engine_kwargs: tp.Any) -> "ServingFleet":
+        """Stand up a homogeneous fleet: `engines` paged DecodeEngines
+        (each `cache_scope`d by its name — mandatory for co-resident
+        engines), one shared uid counter across the member schedulers,
+        and one SLOEngine per member (`engine_budget_sets`). Extra
+        kwargs go to every DecodeEngine."""
+        from ...observability.slo import (DEFAULT_SLO_BUDGETS,
+                                          engine_budget_sets)
+        from ..engine import DecodeEngine
+        if engines < 1:
+            raise ValueError(f"need >= 1 engine, got {engines}")
+        names = list(names) if names is not None \
+            else [f"engine{i}" for i in range(engines)]
+        if len(names) != engines:
+            raise ValueError(f"{len(names)} names for {engines} engines")
+        engine_kwargs.setdefault("cache_layout", "paged")
+        slos = engine_budget_sets(names,
+                                  budgets=slo_budgets or
+                                  DEFAULT_SLO_BUDGETS,
+                                  **(slo_kwargs or {}))
+        uid_source = itertools.count()
+        members = []
+        for name in names:
+            engine = DecodeEngine(model, params, slots=slots,
+                                  cache_scope=name, **engine_kwargs)
+            metrics = ServeMetrics(tracer=engine.tracer, slo=slos[name])
+            scheduler = ContinuousBatchingScheduler(
+                engine, max_queue=max_queue, metrics=metrics,
+                tracing=tracing, uid_source=uid_source)
+            members.append(FleetMember(name, scheduler, slo=slos[name]))
+        return cls(members, quotas=quotas, policy=policy, tracing=tracing)
+
+    def warmup(self, prompt_lengths: tp.Iterable[int] = ()) -> None:
+        """Pre-compile every member's executables (distinct cache
+        scopes keep the zero-post-warm-up-recompiles gate per-engine).
+        `prompt_lengths` sizes the prefill buckets, exactly as
+        `DecodeEngine.warmup` — EVERY member gets the full set, since
+        routing (or a death re-route) can land any prompt anywhere."""
+        lengths = list(prompt_lengths)
+        for member in self.members.values():
+            member.engine.warmup(prompt_lengths=lengths)
+
+    # ------------------------------------------------------------------
+    # the front door
+    # ------------------------------------------------------------------
+    @property
+    def healthy(self) -> tp.List[str]:
+        return [n for n, m in self.members.items() if m.healthy]
+
+    def alerting(self) -> tp.Set[str]:
+        """Members whose SLOEngine has at least one budget burning over
+        both windows right now — the router redirects around them."""
+        return {name for name, member in self.members.items()
+                if member.slo is not None and member.slo.alerts()}
+
+    def submit(self, prompt: tp.Any, max_new_tokens: int,
+               eos_token: tp.Optional[int] = None,
+               ttl: tp.Optional[float] = None,
+               tenant: str = "default",
+               priority: tp.Optional[int] = None) -> Request:
+        """Route one request to a member queue; returns its handle.
+
+        Sheds with QueueFull when the tenant is over quota or the
+        routed member's queue is full (quota credit returned) — the
+        same backpressure signal either way. `priority` defaults to
+        the tenant's quota class.
+        """
+        if priority is None:
+            priority = self.quotas.quota_for(tenant).priority
+        if not self.quotas.try_acquire(tenant):
+            raise QueueFull(
+                f"tenant {tenant!r} is at its in-flight quota "
+                f"({self.quotas.quota_for(tenant).max_inflight})")
+        decision = self.router.route(self._route_seq, prompt,
+                                     healthy=self.healthy,
+                                     alerting=self.alerting())
+        self._route_seq += 1
+        member = self.members[decision.engine]
+        try:
+            request = member.scheduler.submit(
+                prompt, max_new_tokens, eos_token=eos_token, ttl=ttl,
+                tenant=tenant, priority=priority)
+        except (QueueFull, ValueError):
+            self.quotas.release(tenant)
+            raise
+        self.route_reasons[decision.reason] = \
+            self.route_reasons.get(decision.reason, 0) + 1
+        self.engine_routed[decision.engine] += 1
+        self._inflight[request.uid] = [request, tenant, decision.engine]
+        return request
+
+    # ------------------------------------------------------------------
+    # stepping + death
+    # ------------------------------------------------------------------
+    def kill(self, name: str) -> int:
+        """Declare a member dead and re-route its in-flight requests to
+        the survivors; returns how many were re-routed. The dead
+        engine is never touched again (no retire/release against it —
+        it is gone); each drained request re-queues elsewhere with its
+        generated tokens retained, so re-admission prefills
+        prompt+generated and the re-served output is token-exact."""
+        member = self.members[name]
+        if not member.healthy:
+            raise ValueError(f"member {name!r} is already dead")
+        member.healthy = False
+        self.deaths.append(name)
+        survivors = self.healthy
+        if not survivors:
+            raise RuntimeError(
+                f"engine {name!r} died and no healthy members remain")
+        drained = member.scheduler.drain_for_reroute()
+        for request in drained:
+            decision = self.router.route(request.uid, request.prompt,
+                                         healthy=survivors)
+            target = self.members[decision.engine]
+            target.scheduler.enqueue(request)
+            if request.uid in self._inflight:
+                self._inflight[request.uid][2] = decision.engine
+            self.reroutes += 1
+            if self.tracing is not None:
+                self.tracing.on_handoff(request, src=name,
+                                        dst=decision.engine)
+        logger.warning("engine %s died; re-routed %d in-flight requests "
+                       "to %s", name, len(drained), survivors)
+        return len(drained)
+
+    def _reap(self) -> None:
+        """Return quota credits for requests that finished this step."""
+        for uid in [u for u, (r, _, _) in self._inflight.items()
+                    if r.done]:
+            _, tenant, _ = self._inflight.pop(uid)
+            self.quotas.release(tenant)
+
+    def step(self) -> int:
+        """One scheduler step on every healthy member; returns total
+        tokens emitted. Each member's step is preceded by the
+        `fleet.engine_step` fault point — an InjectedFault there kills
+        that member (drain + re-route) and the step goes on with the
+        survivors."""
+        emitted = 0
+        for name in list(self.members):
+            member = self.members[name]
+            if not member.healthy:
+                continue
+            try:
+                fault_point(ENGINE_FAULT_SITE, engine=name,
+                            live=member.scheduler.live_count,
+                            queue_depth=member.scheduler.queue_depth)
+            except InjectedFault as exc:
+                logger.warning("engine %s killed by fault injection: %s",
+                               name, exc)
+                self.kill(name)
+                continue
+            emitted += member.scheduler.step()
+        self._reap()
+        return emitted
+
+    def run(self, max_steps: int = 1_000_000) -> None:
+        """Step until every healthy member drained (same watchdog
+        contract as the single-engine scheduler.run)."""
+        for _ in range(max_steps):
+            if all(m.scheduler.idle for m in self.members.values()
+                   if m.healthy):
+                self._reap()
+                return
+            self.step()
+        raise RuntimeError(f"fleet did not drain in {max_steps} steps")
+
+    # ------------------------------------------------------------------
+    # status
+    # ------------------------------------------------------------------
+    def status(self) -> tp.Dict[str, tp.Any]:
+        """Topology + health snapshot (what fleet.json holds)."""
+        engines: tp.Dict[str, tp.Any] = {}
+        for name, member in self.members.items():
+            engine = member.engine
+            entry: tp.Dict[str, tp.Any] = {
+                "role": member.role,
+                "healthy": member.healthy,
+                "slots": engine.slots,
+                "live": engine.live_count,
+                "occupancy": (engine.live_count / engine.slots
+                              if engine.slots else 0.0),
+                "queue_depth": member.scheduler.queue_depth,
+                "routed": self.engine_routed.get(name, 0),
+            }
+            pool = engine.pool_stats()
+            if pool is not None:
+                entry["pool_occupancy"] = pool["occupancy"]
+                entry["prefix_hit_rate"] = pool["prefix_hit_rate"]
+            if member.slo is not None:
+                report = member.slo.evaluate()
+                entry["slo_alerting"] = sorted(
+                    n for n, b in report["budgets"].items()
+                    if b["alerting"])
+                entry["slo_burn"] = {
+                    n: b["burn_slow"]
+                    for n, b in report["budgets"].items()
+                    if b["burn_slow"] is not None}
+            engines[name] = entry
+        return {
+            "engines": engines,
+            "policy": self.router.policy,
+            "tenants": self.quotas.summary(),
+            "route_reasons": dict(sorted(self.route_reasons.items())),
+            "reroutes": self.reroutes,
+            "deaths": list(self.deaths),
+        }
+
+    def write_status(self, folder: AnyPath) -> Path:
+        """Snapshot `status()` to `<folder>/fleet.json` (atomic rename,
+        same discipline as serve.json) for `python -m flashy_tpu.info`."""
+        target = Path(folder) / FLEET_STATUS_NAME
+        target.parent.mkdir(parents=True, exist_ok=True)
+        with write_and_rename(target, "w") as f:
+            json.dump(self.status(), f, indent=2, default=float)
+        return target

@@ -1,0 +1,194 @@
+# Prefill/decode disaggregation. Prefill is compute-bound (one big
+# matmul burst per prompt) and decode is bandwidth-bound (one tiny step
+# per token, forever); co-locating them makes each the other's noisy
+# neighbour — the classic serving split runs them on separate engines.
+# The paged layout makes the transfer almost free: both engines index
+# the SAME device block pool through their own tables, so moving a
+# request is re-keying a `BlockPool` reservation and installing a table
+# row — a block id LIST crosses the boundary, never a K/V slab. Token
+# exactness is the purity argument the paged cache rests on: K/V rows
+# are pure functions of (token, position, params), and the decode
+# engine shares all three with the prefill engine, so continuing from
+# the handed-off blocks is bit-identical to never having moved.
+"""Handoff: move a request's KV state between engines as a block list."""
+import dataclasses
+import typing as tp
+
+
+@dataclasses.dataclass(frozen=True)
+class HandoffPacket:
+    """Everything that crosses the prefill->decode boundary.
+
+    `blocks` is the ordered pool block id list backing the request's
+    table row, `position` the next write position (prompt + generated
+    length), `last_token` the last emitted token the decode step feeds
+    back. Note what is ABSENT: no K/V tensors — the blocks already
+    live in the shared pool, the packet only names them.
+    """
+    blocks: tp.Tuple[int, ...]
+    position: int
+    last_token: int
+    src: str = ""  # engine names, for the journal/trace record
+    dst: str = ""
+
+
+def hand_off(src: tp.Any, dst: tp.Any, slot: int,
+             dst_slot: tp.Optional[int] = None
+             ) -> tp.Tuple[int, HandoffPacket]:
+    """Move the live request in `src`'s `slot` onto `dst`; returns
+    `(dst_slot, packet)`.
+
+    Three steps, in an order chosen so a failure leaves a consistent
+    pool: (1) claim the destination slot (fails before anything moved);
+    (2) export + detach from `src` (`release_for_handoff`: blocks stay
+    reserved); (3) re-key the reservation (`BlockPool.transfer_slot`)
+    and install it (`adopt_handoff`). Requires both engines share ONE
+    `BlockPool` and ONE `CacheBox` — block ids only name K/V both
+    sides can actually read — and disjoint `pool_slot_base` ranges
+    (constructor-validated by `DisaggregatedPair`).
+    """
+    if src.pool is None or src.pool is not dst.pool:
+        raise ValueError("handoff requires src and dst to share one "
+                         "BlockPool (the block ids must name the same "
+                         "device pool)")
+    if src.cache_box is not dst.cache_box:
+        raise ValueError("handoff requires src and dst to share one "
+                         "CacheBox — separate device pytrees would make "
+                         "the block list name K/V the destination "
+                         "cannot read")
+    new_slot = dst.acquire_slot(dst_slot)
+    if new_slot is None:
+        raise RuntimeError(f"destination engine has no free slot for "
+                           f"the handoff (live {dst.live_count}/"
+                           f"{dst.slots})")
+    state = src.release_for_handoff(slot)
+    dst.pool.transfer_slot(src.pool_key(slot), dst.pool_key(new_slot))
+    dst.adopt_handoff(new_slot, state["blocks"], state["last_token"],
+                      state["position"])
+    packet = HandoffPacket(blocks=tuple(state["blocks"]),
+                           position=state["position"],
+                           last_token=state["last_token"],
+                           src=src.cache_scope, dst=dst.cache_scope)
+    return new_slot, packet
+
+
+class DisaggregatedPair:
+    """A prefill-role and a decode-role engine over one shared pool.
+
+    Builds both `DecodeEngine`s against the same `BlockPool` and
+    `CacheBox` with disjoint `pool_slot_base` ranges and distinct
+    `cache_scope`s (mandatory: two engines in one process would
+    otherwise collide in the compile cache / recompile watchdog).
+    `serve(prompts, max_new_tokens)` is the reference driver the demo
+    gates on: admit + chunk-prefill every prompt on the prefill engine,
+    `hand_off` each completed prefill to the decode engine, then run
+    ONE [S,1] decode step loop over all handed-off slots concurrently —
+    mixed lengths retire independently, exactly like the continuous-
+    batching scheduler, and greedy output is token-exact vs
+    `generate()`.
+
+    Args:
+        model / params: the served TransformerLM.
+        prefill_slots / decode_slots: concurrency of each role.
+        max_seq_len: per-request cap (defaults to the model's).
+        block_size: paged pool block size.
+        num_blocks: shared pool size; defaults to the worst case of
+            BOTH engines' slots reserving full budgets at once (during
+            a handoff the reservation exists on exactly one side, so
+            the sum is the true peak).
+        kwargs: forwarded to both engines (kernel, kv_dtype, ...).
+    """
+
+    def __init__(self, model, params, *, prefill_slots: int = 2,
+                 decode_slots: int = 4,
+                 max_seq_len: tp.Optional[int] = None,
+                 block_size: int = 16,
+                 num_blocks: tp.Optional[int] = None,
+                 prefix_cache: bool = True,
+                 **kwargs: tp.Any):
+        from ..engine import DecodeEngine
+        from ..paged import BlockPool, CacheBox
+        max_seq_len = min(max_seq_len or model.config.max_seq_len,
+                          model.config.max_seq_len)
+        if num_blocks is None:
+            num_blocks = 1 + (prefill_slots + decode_slots) \
+                * (max_seq_len // block_size)
+        self.pool = BlockPool(num_blocks=num_blocks, block_size=block_size,
+                              max_seq_len=max_seq_len,
+                              prefix_cache=prefix_cache)
+        self.cache_box = CacheBox()
+        self.prefill = DecodeEngine(
+            model, params, slots=prefill_slots, max_seq_len=max_seq_len,
+            cache_layout="paged", block_size=block_size,
+            num_blocks=num_blocks, cache_scope="prefill",
+            pool=self.pool, cache_box=self.cache_box, pool_slot_base=0,
+            prefix_cache=prefix_cache, **kwargs)
+        self.decode = DecodeEngine(
+            model, params, slots=decode_slots, max_seq_len=max_seq_len,
+            cache_layout="paged", block_size=block_size,
+            num_blocks=num_blocks, cache_scope="decode",
+            pool=self.pool, cache_box=self.cache_box,
+            pool_slot_base=prefill_slots,
+            prefix_cache=prefix_cache, **kwargs)
+        self.handoffs: tp.List[HandoffPacket] = []
+
+    def warmup(self, prompt_lengths: tp.Iterable[int] = ()) -> None:
+        """Pre-compile both engines' executables (each under its own
+        cache scope — the zero-post-warm-up-recompiles gate holds per
+        engine). `prompt_lengths` sizes the prefill buckets, exactly
+        as `DecodeEngine.warmup`."""
+        lengths = list(prompt_lengths)
+        self.prefill.warmup(prompt_lengths=lengths)
+        self.decode.warmup(prompt_lengths=lengths)
+
+    def serve(self, prompts: tp.Sequence[tp.Any],
+              max_new_tokens: int,
+              eos_token: tp.Optional[int] = None
+              ) -> tp.List[tp.List[int]]:
+        """Run every prompt through prefill -> handoff -> decode;
+        returns each request's generated tokens (prompt excluded), in
+        submission order. Prompts are processed in waves of at most
+        `decode_slots` so mixed-length requests decode CONCURRENTLY
+        (one [S,1] step advances all of them; finished slots retire
+        independently)."""
+        import numpy as np
+        results: tp.List[tp.List[int]] = [[] for _ in prompts]
+        pending = list(range(len(prompts)))
+        while pending:
+            wave = pending[:self.decode.slots]
+            pending = pending[len(wave):]
+            # phase 1: prefill each wave member (bounded by prefill
+            # slots), hand finished prefills to the decode engine
+            live: tp.Dict[int, int] = {}  # decode slot -> request index
+            budgets: tp.Dict[int, int] = {}
+            for i in wave:
+                prompt = np.asarray(prompts[i], np.int32)
+                slot = self.prefill.acquire_slot()
+                assert slot is not None, "wave exceeds prefill slots?"
+                start = self.prefill.admit(slot, prompt, max_new_tokens)
+                first: tp.Optional[int] = None
+                while first is None:
+                    start, first = self.prefill.prefill_chunk(
+                        slot, prompt, start)
+                results[i].append(first)
+                if max_new_tokens == 1 or (eos_token is not None
+                                           and first == eos_token):
+                    self.prefill.retire(slot)
+                    continue
+                dslot, packet = hand_off(self.prefill, self.decode, slot)
+                self.handoffs.append(packet)
+                live[dslot] = i
+                budgets[dslot] = max_new_tokens - 1
+            # phase 2: one decode loop over every handed-off slot
+            while live:
+                tokens = self.decode.decode()
+                for dslot in list(live):
+                    i = live[dslot]
+                    token = int(tokens[dslot])
+                    results[i].append(token)
+                    budgets[dslot] -= 1
+                    if budgets[dslot] <= 0 or (eos_token is not None
+                                               and token == eos_token):
+                        self.decode.retire(dslot)
+                        del live[dslot], budgets[dslot]
+        return results

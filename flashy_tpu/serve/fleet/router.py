@@ -1,0 +1,143 @@
+# The fleet front door. Routing is where a multi-engine deployment
+# either compounds the paged layout's prefix cache or throws it away:
+# every engine keeps its OWN PrefixIndex, so two requests sharing a
+# system prompt only share K/V if the router lands them on the same
+# engine. The routing key is therefore the prefix-cache chain key
+# itself — the token content of the prompt's first full block, exactly
+# the first link of the `(parent_key, tokens.tobytes())` chain
+# `PrefixIndex.match` walks — hashed with a fixed, unseeded-by-Python
+# FNV-1a so the same (key, fleet) routes identically in every process
+# and rerun. Replayable routing is not a nicety: the engine-death drill
+# re-serves a dead engine's requests token-exactly, and debugging THAT
+# requires knowing where each request went and why.
+"""FleetRouter: deterministic prefix-sticky request routing."""
+import dataclasses
+import typing as tp
+
+import numpy as np
+
+# FNV-1a 64-bit offset basis / prime: a deterministic bytes -> int hash
+# (NOT Python's hash(), which is salted per process and would make
+# routing irreproducible — the same trap serve/tracing.py's sampler
+# avoids with its Knuth multiplicative hash).
+_FNV_OFFSET = 0xcbf29ce484222325
+_FNV_PRIME = 0x100000001b3
+_FNV_MOD = 1 << 64
+
+POLICIES = ("sticky", "round_robin")
+
+
+def fnv1a(data: bytes, seed: int = 0) -> int:
+    """64-bit FNV-1a of `data`, optionally perturbed by `seed` —
+    deterministic across processes, platforms and reruns."""
+    h = _FNV_OFFSET
+    if seed:
+        for b in seed.to_bytes(8, "little"):
+            h = ((h ^ b) * _FNV_PRIME) % _FNV_MOD
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) % _FNV_MOD
+    return h
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteDecision:
+    """Where one request goes and why (journaled by the fleet).
+
+    `engine` is the chosen member name; `reason` is 'sticky' (chain-key
+    hash), 'round_robin' (uid modulo), or 'slo_redirect' (the sticky
+    target was burning its SLO budgets, the request moved to the next
+    healthy non-alerting engine on the probe ring). `key_hash` is the
+    FNV-1a of the routing key — stable across reruns, so a journal of
+    decisions is replayable evidence.
+    """
+    engine: str
+    reason: str
+    key_hash: int
+
+
+class FleetRouter:
+    """Prefix-cache-aware sticky routing over a named engine set.
+
+    The chain key of a prompt is the byte content of its FIRST FULL
+    block (`prompt[:block_size].tobytes()`) — the root link of the
+    `PrefixIndex` chain every admission walks. Requests sharing a
+    system-prompt header of at least one block therefore share a chain
+    key, hash to the same engine, and hit that engine's prefix cache;
+    prompts shorter than a block fall back to their full token bytes
+    (nothing block-granular to share, but routing stays deterministic).
+
+    `policy='round_robin'` is the baseline the sticky gate compares
+    against: uid modulo fleet size, deterministic but prefix-blind.
+
+    Args:
+        engines: ordered member names; order is part of the routing
+            contract (the hash indexes into the HEALTHY subsequence in
+            this order).
+        block_size: the paged block size defining "first full block".
+        policy: 'sticky' (default) or 'round_robin'.
+        seed: perturbs the sticky hash — a different seed is a
+            different (still deterministic) placement.
+    """
+
+    def __init__(self, engines: tp.Sequence[str], block_size: int,
+                 policy: str = "sticky", seed: int = 0):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("need at least one engine name")
+        if len(set(engines)) != len(engines):
+            raise ValueError(f"duplicate engine names in {engines}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, "
+                             f"got {policy!r}")
+        self.engines = engines
+        self.block_size = int(block_size)
+        self.policy = policy
+        self.seed = int(seed)
+
+    def chain_key(self, prompt: np.ndarray) -> bytes:
+        """The routing key: byte content of the prompt's first full
+        block (the root of its `PrefixIndex` chain), or the whole
+        prompt's bytes when it is shorter than one block."""
+        prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        if prompt.size >= self.block_size:
+            return prompt[:self.block_size].tobytes()
+        return prompt.tobytes()
+
+    def route(self, uid: int, prompt: np.ndarray,
+              healthy: tp.Optional[tp.Collection[str]] = None,
+              alerting: tp.Collection[str] = ()) -> RouteDecision:
+        """Pick the engine for one request.
+
+        `healthy` restricts the candidate set (engine death removes a
+        member mid-run; None means all). `alerting` names engines whose
+        SLO burn says shed/redirect: a sticky/round-robin target that
+        is alerting redirects to the next non-alerting candidate on the
+        probe ring — and when EVERY candidate is alerting the original
+        target is kept (the fleet's admission door decides whether to
+        shed; the router only places). Deterministic in (uid, chain
+        key, candidate list): same inputs, same decision, any process.
+        """
+        candidates = [e for e in self.engines
+                      if healthy is None or e in healthy]
+        if not candidates:
+            raise RuntimeError("no healthy engines to route to")
+        key = self.chain_key(prompt)
+        key_hash = fnv1a(key, seed=self.seed)
+        if self.policy == "sticky":
+            start = key_hash % len(candidates)
+            reason = "sticky"
+        else:
+            start = uid % len(candidates)
+            reason = "round_robin"
+        choice = candidates[start]
+        if choice in alerting:
+            for step in range(1, len(candidates)):
+                probe = candidates[(start + step) % len(candidates)]
+                if probe not in alerting:
+                    return RouteDecision(engine=probe,
+                                         reason="slo_redirect",
+                                         key_hash=key_hash)
+        return RouteDecision(engine=choice, reason=reason,
+                             key_hash=key_hash)

@@ -66,8 +66,13 @@ class ServeMetrics:
         self.completed = 0
         self.rejected = 0
         self.expired = 0
+        self.preempted = 0
         self.tokens = 0
         self.finish_reasons: tp.Dict[str, int] = {}
+        # per-tenant rollups: tenant -> {requests, completed, tokens,
+        # shed, preempted}; "shed" counts rejections AND expiries — the
+        # two ways a tenant's request leaves without running
+        self.tenants: tp.Dict[str, tp.Dict[str, int]] = {}
         self.ttft: tp.List[float] = []
         self.itl: tp.List[float] = []
         self.latency: tp.List[float] = []
@@ -91,17 +96,32 @@ class ServeMetrics:
     # ------------------------------------------------------------------
     # scheduler hooks
     # ------------------------------------------------------------------
-    def on_submit(self) -> None:
+    def _tenant(self, tenant: tp.Optional[str]) -> tp.Dict[str, int]:
+        return self.tenants.setdefault(
+            tenant or "default",
+            {"requests": 0, "completed": 0, "tokens": 0, "shed": 0,
+             "preempted": 0})
+
+    def on_submit(self, tenant: tp.Optional[str] = None) -> None:
         self.submitted += 1
+        self._tenant(tenant)["requests"] += 1
 
-    def on_reject(self) -> None:
+    def on_reject(self, tenant: tp.Optional[str] = None) -> None:
         self.rejected += 1
+        self._tenant(tenant)["shed"] += 1
 
-    def on_expired(self) -> None:
+    def on_expired(self, tenant: tp.Optional[str] = None) -> None:
         """A queued request shed past its TTL deadline (never ran)."""
         self.expired += 1
         self.finish_reasons["expired"] = \
             self.finish_reasons.get("expired", 0) + 1
+        self._tenant(tenant)["shed"] += 1
+
+    def on_preempt(self, tenant: tp.Optional[str] = None) -> None:
+        """A running request evicted mid-decode for a higher-priority
+        admission (it re-queues and resumes; nothing is lost)."""
+        self.preempted += 1
+        self._tenant(tenant)["preempted"] += 1
 
     def on_first_token(self, ttft_seconds: float) -> None:
         self.ttft.append(ttft_seconds)
@@ -121,10 +141,16 @@ class ServeMetrics:
         if self.slo is not None:
             self.slo.observe("queue_wait", wait_seconds)
 
-    def on_done(self, latency_seconds: float, reason: str) -> None:
+    def on_done(self, latency_seconds: float, reason: str,
+                tenant: tp.Optional[str] = None,
+                tokens: tp.Optional[int] = None) -> None:
         self.completed += 1
         self.latency.append(latency_seconds)
         self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
+        entry = self._tenant(tenant)
+        entry["completed"] += 1
+        if tokens:
+            entry["tokens"] += int(tokens)
 
     def on_spec_step(self, drafted: int, accepted: tp.Sequence[int],
                      emitted: int) -> None:
@@ -194,6 +220,7 @@ class ServeMetrics:
             "completed": self.completed,
             "rejected": self.rejected,
             "expired": self.expired,
+            "preempted": self.preempted,
             "tokens": self.tokens,
         }
         for name, samples, scale in (("ttft_ms", self.ttft, 1e3),
@@ -248,10 +275,14 @@ class ServeMetrics:
         """Snapshot the summary to `<folder>/serve.json` (atomic) for
         `python -m flashy_tpu.info`; returns the path. When an SLOEngine
         is attached its evaluation lands as the `slo` block (what
-        `info --slo` renders)."""
+        `info --slo` renders); per-tenant request/token/shed rollups
+        land as the `tenants` block."""
         target = Path(folder) / SERVE_STATUS_NAME
         payload: tp.Dict[str, tp.Any] = dict(self.static_info)
         payload.update(self.summary())
+        if self.tenants:
+            payload["tenants"] = {t: dict(counts) for t, counts
+                                  in sorted(self.tenants.items())}
         if self.slo is not None:
             payload["slo"] = self.slo.evaluate()
         if extra:

@@ -62,6 +62,29 @@ class PoolExhausted(RuntimeError):
     """
 
 
+class CacheBox:
+    """One level of indirection over the device pool pytree so MULTIPLE
+    engines can read and write the SAME K/V blocks.
+
+    Every compiled step returns a fresh pytree (functional update, with
+    donation on accelerators), so an engine rebinds its cache reference
+    after each call; two engines sharing plain attributes would diverge
+    at the first step. Both instead hold one CacheBox and go through
+    `value` — the disaggregated prefill->decode pair in
+    `flashy_tpu.serve.fleet` is the user: the prefill engine fills
+    blocks, rebinding `value`, and the decode engine's next step reads
+    the very same arrays through its own block tables. Safe because the
+    scheduler/fleet loop is host-sequential: only one engine's step is
+    in flight at a time, and after a donated step the stale buffers are
+    unreachable (the box was rebound before anyone else reads it).
+    """
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: tp.Any = None):
+        self.value = value
+
+
 _ROOT = ("root",)
 
 
@@ -250,6 +273,8 @@ class BlockPool:
         self.cow_forks = 0
         self.prefix_matched_tokens = 0
         self.prefix_total_tokens = 0
+        self.preemptions = 0
+        self.handoffs = 0
 
     # ------------------------------------------------------------------
     # accounting views
@@ -432,6 +457,53 @@ class BlockPool:
                 freed.append(b)
         return freed
 
+    def evict_slot(self, slot: int) -> tp.List[int]:
+        """Preempt a live slot: atomically tear down its reservation
+        mid-flight and return the blocks actually freed.
+
+        The preemption primitive (`flashy_tpu.serve.fleet` quota /
+        priority classes): every block the slot references drops one
+        refcount, and blocks nothing else holds return to the free list
+        — EXCEPT prompt blocks the prefix index still caches, which
+        stay resident at refcount 0. That is what makes preemption
+        rollback cheap: the preempted request's re-admission re-matches
+        its own prompt chain, so the re-prefill shrinks to the uncached
+        suffix plus whatever it had generated. No K/V cleanup is needed
+        for rows the request wrote past its prompt: once the engine
+        parks the slot's position they sit beyond every causal horizon
+        until a later reservation overwrites them — the same
+        rollback-is-free argument as speculative rejection.
+
+        Identical conservation outcome to `release()` (the invariant
+        `check()` asserts holds across either), kept as a distinct
+        verb so preemptions are separately counted and auditable.
+        Raises KeyError for a slot holding no reservation.
+        """
+        if slot not in self._slots:
+            raise KeyError(f"slot {slot} holds no reservation to evict")
+        self.preemptions += 1
+        return self.release(slot)
+
+    def transfer_slot(self, src: int, dst: int) -> tp.List[int]:
+        """Re-key a reservation from slot `src` to slot `dst` (the
+        disaggregated prefill->decode handoff).
+
+        Refcounts, the prefix index, and the device blocks themselves
+        are untouched — ownership of the SAME block list moves between
+        slot keys, which is the whole point of paged disaggregation:
+        the transfer unit is a block id list, never a K/V slab. Returns
+        the ordered block list now keyed to `dst`. Raises KeyError when
+        `src` holds no reservation and ValueError when `dst` already
+        holds one.
+        """
+        if src not in self._slots:
+            raise KeyError(f"slot {src} holds no reservation to transfer")
+        if dst in self._slots:
+            raise ValueError(f"slot {dst} already holds a reservation")
+        self._slots[dst] = self._slots.pop(src)
+        self.handoffs += 1
+        return list(self._slots[dst][1])
+
     def holds(self, slot: int) -> bool:
         """Whether `slot` currently holds a reservation."""
         return slot in self._slots
@@ -485,6 +557,8 @@ class BlockPool:
             "cow_forks": self.cow_forks,
             "allocated_total": self.allocated_total,
             "prefix_hit_rate": self.prefix_hit_rate,
+            "preemptions": self.preemptions,
+            "handoffs": self.handoffs,
         }
 
 

@@ -49,6 +49,8 @@ class Request:
     prompt: np.ndarray
     max_new_tokens: int
     eos_token: tp.Optional[int] = None
+    tenant: str = "default"
+    priority: int = 0
     state: str = "queued"
     slot: tp.Optional[int] = None
     generated: tp.List[int] = dataclasses.field(default_factory=list)
@@ -58,6 +60,7 @@ class Request:
     first_token_at: tp.Optional[float] = None
     finished_at: tp.Optional[float] = None
     finish_reason: tp.Optional[str] = None  # 'eos' | 'length' | 'expired'
+    preemptions: int = 0  # times this request was evicted mid-flight
 
     @property
     def done(self) -> bool:
@@ -68,6 +71,23 @@ class Request:
         """prompt + generated tokens, as one int32 array."""
         return np.concatenate([np.asarray(self.prompt, np.int32),
                                np.asarray(self.generated, np.int32)])
+
+    @property
+    def resume_prompt(self) -> np.ndarray:
+        """What admission must prefill: the original prompt plus any
+        tokens already generated before a preemption / engine death put
+        this request back in a queue. Re-prefilling the retained
+        output re-derives the exact K/V state the evicted slot held
+        (K/V rows are pure functions of (token, position, params)), so
+        a resumed request's remaining tokens are token-exact."""
+        return self.output if self.generated \
+            else np.asarray(self.prompt, np.int32)
+
+    @property
+    def remaining_budget(self) -> int:
+        """max_new_tokens net of tokens already generated — the decode
+        budget a resumed admission still owes this request."""
+        return self.max_new_tokens - len(self.generated)
 
 
 class ContinuousBatchingScheduler:
@@ -114,13 +134,29 @@ class ContinuousBatchingScheduler:
         tracing: optional `serve.tracing.RequestTracer`; every request
             lifecycle transition is mirrored to it (async Perfetto
             spans + requests.jsonl), subject to its sampling policy.
+        uid_source: an iterator yielding request uids; by default each
+            scheduler counts privately from 0. A fleet passes ONE
+            shared `itertools.count` to every member scheduler so uids
+            stay unique across engines (routing and re-routing key on
+            them).
+
+    Priority classes: admission picks the highest-`priority` queued
+    request first (FIFO among equals, so the default all-zero workload
+    keeps the arrival-order fairness the tests assert), and a blocked
+    high-priority request PREEMPTS the lowest-priority strictly-lower
+    running request: the victim's blocks are evicted
+    (`BlockPool.evict_slot` — prefix-cached prompt blocks stay
+    resident), the victim re-queues with its generated tokens
+    retained, and its eventual re-admission prefills prompt+generated
+    so the remaining tokens are token-exact (K/V purity).
     """
 
     def __init__(self, engine: DecodeEngine, max_queue: int = 128,
                  metrics: tp.Optional[ServeMetrics] = None,
                  draft: tp.Optional[tp.Any] = None,
                  prefill_chunks_per_step: int = 1,
-                 tracing: tp.Optional[tp.Any] = None):
+                 tracing: tp.Optional[tp.Any] = None,
+                 uid_source: tp.Optional[tp.Iterator[int]] = None):
         self.engine = engine
         self.max_queue = max_queue
         self.metrics = metrics or ServeMetrics(tracer=engine.tracer)
@@ -141,10 +177,12 @@ class ContinuousBatchingScheduler:
         self.prefill_chunks_per_step = prefill_chunks_per_step
         self._queue: tp.Deque[Request] = collections.deque()
         self._running: tp.Dict[int, Request] = {}  # slot -> request
-        # slot -> [request, next chunk start]; insertion order == FIFO
+        # slot -> [request, next chunk start, prompt being prefilled
+        # (resume_prompt at admission)]; insertion order == FIFO
         self._prefilling: tp.Dict[int, tp.List[tp.Any]] = {}
         self._draft_slots: tp.Set[int] = set()  # slots the draft tracks
-        self._uid = itertools.count()
+        self._uid = uid_source if uid_source is not None \
+            else itertools.count()
         self.admitted_order: tp.List[int] = []  # uids, admission sequence
         # prompt tokens prefilled in the latest step / the max over the
         # run — the demo asserts max <= chunk (the stall bound).
@@ -169,7 +207,9 @@ class ContinuousBatchingScheduler:
 
     def submit(self, prompt: tp.Any, max_new_tokens: int,
                eos_token: tp.Optional[int] = None,
-               ttl: tp.Optional[float] = None) -> Request:
+               ttl: tp.Optional[float] = None,
+               tenant: str = "default",
+               priority: int = 0) -> Request:
         """Queue one request; returns its Request handle.
 
         Raises QueueFull at the depth cap and ValueError for requests
@@ -180,8 +220,16 @@ class ContinuousBatchingScheduler:
         slot. `ttl` (seconds) is an optional queue-wait budget: a
         request still queued past its deadline is shed with
         `finish_reason='expired'` instead of being prefilled after the
-        client stopped waiting for it.
+        client stopped waiting for it. `tenant` labels the request's
+        per-tenant metric rollups (and quota accounting at the fleet
+        door); `priority` picks its admission class — higher admits
+        first and may preempt strictly-lower running requests.
         """
+        if not isinstance(tenant, str) or not tenant:
+            raise ValueError(f"tenant must be a non-empty string, "
+                             f"got {tenant!r}")
+        if not isinstance(priority, int) or isinstance(priority, bool):
+            raise ValueError(f"priority must be an int, got {priority!r}")
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size < 1:
             raise ValueError(f"prompt must be 1-D non-empty, got {prompt.shape}")
@@ -200,7 +248,7 @@ class ContinuousBatchingScheduler:
         if ttl is not None and ttl <= 0:
             raise ValueError(f"ttl must be positive (seconds), got {ttl}")
         if len(self._queue) >= self.max_queue:
-            self.metrics.on_reject()
+            self.metrics.on_reject(tenant=tenant)
             if self.tracing is not None:
                 self.tracing.on_reject(len(self._queue))
             raise QueueFull(
@@ -209,10 +257,11 @@ class ContinuousBatchingScheduler:
         now = time.perf_counter()
         request = Request(uid=next(self._uid), prompt=prompt,
                           max_new_tokens=max_new_tokens, eos_token=eos_token,
+                          tenant=tenant, priority=priority,
                           submitted_at=now,
                           deadline=now + ttl if ttl is not None else None)
         self._queue.append(request)
-        self.metrics.on_submit()
+        self.metrics.on_submit(tenant=tenant)
         if self.tracing is not None:
             self.tracing.on_submit(request)
         return request
@@ -234,7 +283,7 @@ class ContinuousBatchingScheduler:
                 request.state = "done"
                 request.finish_reason = "expired"
                 request.finished_at = now
-                self.metrics.on_expired()
+                self.metrics.on_expired(tenant=request.tenant)
                 if self.tracing is not None:
                     self.tracing.on_finish(request, "expired")
                 shed += 1
@@ -248,13 +297,19 @@ class ContinuousBatchingScheduler:
     def _first_token(self, slot: int, request: Request,
                      first: int) -> None:
         """Prefill completed: record TTFT, seed the draft, and either
-        retire the request (EOS / budget of 1) or start decoding it."""
+        retire the request (EOS / budget of 1) or start decoding it.
+        A RESUMED request (preempted / re-routed after engine death)
+        lands here again when its prompt+generated re-prefill finishes;
+        its TTFT was already recorded, so only the token counts."""
         now = time.perf_counter()
         request.state = "running"
-        request.first_token_at = now
         request.generated.append(first)
-        self.metrics.on_first_token(now - request.submitted_at)
+        if request.first_token_at is None:
+            request.first_token_at = now
+            self.metrics.on_first_token(now - request.submitted_at)
         if self.tracing is not None:
+            # on resume too: the tracer re-opened the queued span at
+            # preemption, and this transition closes its prefill phase
             self.tracing.on_first_token(request)
         if request.eos_token is not None and first == request.eos_token:
             self._finish(request, "eos")
@@ -266,6 +321,100 @@ class ContinuousBatchingScheduler:
                 self.draft.begin(slot, request.prompt, first)
                 self._draft_slots.add(slot)
 
+    def _pop_next(self) -> Request:
+        """Remove and return the next request to admit: the highest
+        `priority`, earliest-queued among equals — so an all-default
+        workload admits in pure arrival order (the FIFO fairness the
+        tests assert) and priority only ever reorders ACROSS classes."""
+        best = 0
+        for i in range(1, len(self._queue)):
+            if self._queue[i].priority > self._queue[best].priority:
+                best = i
+        request = self._queue[best]
+        del self._queue[best]
+        return request
+
+    def _try_preempt(self, priority: int) -> bool:
+        """Evict ONE running request of strictly lower priority to make
+        room for a blocked admission; returns whether a victim existed.
+        The victim is the lowest-priority running request (most recent
+        uid among ties — least sunk decode work by FIFO admission)."""
+        victim: tp.Optional[Request] = None
+        for request in self._running.values():
+            if request.priority >= priority:
+                continue
+            if victim is None \
+                    or (request.priority, -request.uid) \
+                    < (victim.priority, -victim.uid):
+                victim = request
+        if victim is None:
+            return False
+        self.preempt(victim.slot)
+        return True
+
+    def preempt(self, slot: int) -> Request:
+        """Evict the running request in `slot` and re-queue it with its
+        generated tokens retained; returns the victim.
+
+        The engine tears the slot down through `BlockPool.evict_slot`
+        (prompt blocks the prefix index caches stay resident, so the
+        re-admission re-matches them); the victim re-enters the queue
+        at the front of its priority class and its next admission
+        prefills `resume_prompt` with `remaining_budget` — token-exact
+        continuation, since K/V rows are pure functions of
+        (token, position, params).
+        """
+        request = self._running.pop(slot)
+        if slot in self._draft_slots:
+            self._draft_slots.discard(slot)
+            self.draft.retire(slot)
+        self.engine.preempt_slot(slot)
+        request.state = "queued"
+        request.slot = None
+        request.preemptions += 1
+        self._queue.appendleft(request)
+        self.metrics.on_preempt(tenant=request.tenant)
+        if self.tracing is not None:
+            self.tracing.on_preempt(request)
+        logger.debug("request %d preempted with %d tokens generated",
+                     request.uid, len(request.generated))
+        return request
+
+    def enqueue(self, request: Request, front: bool = False) -> None:
+        """Re-inject an existing Request (no new uid, no submit
+        metrics) — the re-route path after an engine death: the fleet
+        drains the dead scheduler and enqueues each survivor here. The
+        depth cap is NOT applied: these requests were already admitted
+        once and must not be dropped by the door."""
+        request.state = "queued"
+        request.slot = None
+        if front:
+            self._queue.appendleft(request)
+        else:
+            self._queue.append(request)
+
+    def drain_for_reroute(self) -> tp.List[Request]:
+        """Pull EVERY unfinished request out of this scheduler without
+        touching the engine — the engine is presumed dead, so no
+        retire/release calls are issued against it. Requests come back
+        reset to 'queued' with generated tokens retained (running and
+        prefilling first, by uid, then the queue in order); re-
+        admission elsewhere prefills `resume_prompt`, which re-derives
+        the lost K/V exactly."""
+        in_flight = sorted(
+            list(self._running.values())
+            + [entry[0] for entry in self._prefilling.values()],
+            key=lambda r: r.uid)
+        requests = in_flight + list(self._queue)
+        self._queue.clear()
+        self._running.clear()
+        self._prefilling.clear()
+        self._draft_slots.clear()
+        for request in requests:
+            request.state = "queued"
+            request.slot = None
+        return requests
+
     def _admit(self) -> int:
         """Assign queued requests to free slots and advance prefill;
         returns #admitted (slots assigned this step).
@@ -274,10 +423,12 @@ class ContinuousBatchingScheduler:
         assignment; chunked engines advance at most
         `prefill_chunks_per_step` slices per step across the
         in-progress prefills, oldest first (FIFO down to the tick).
+        A resumed request (preempted earlier) prefills its
+        `resume_prompt` under `remaining_budget`.
         """
         admitted = 0
-        while self._queue and self.engine.free_count:
-            request = self._queue.popleft()
+        while self._queue:
+            request = self._pop_next()
             if (request.deadline is not None
                     and time.perf_counter() >= request.deadline):
                 # expired while earlier admissions in this very step were
@@ -285,25 +436,30 @@ class ContinuousBatchingScheduler:
                 request.state = "done"
                 request.finish_reason = "expired"
                 request.finished_at = time.perf_counter()
-                self.metrics.on_expired()
+                self.metrics.on_expired(tenant=request.tenant)
                 if self.tracing is not None:
                     self.tracing.on_finish(request, "expired")
                 continue
-            if not self.engine.can_admit(request.prompt,
-                                         request.max_new_tokens):
-                # paged layout: the block pool lacks headroom for the
-                # queue head's whole budget. Admission stays FIFO — the
-                # head waits at the front for retirements to free
-                # blocks; meanwhile the queue filling up surfaces as
+            prompt = request.resume_prompt
+            budget = request.remaining_budget
+            if not self.engine.free_count \
+                    or not self.engine.can_admit(prompt, budget):
+                # No free slot, or (paged layout) the block pool lacks
+                # headroom for the head's whole budget. A higher-
+                # priority head may PREEMPT a strictly-lower running
+                # request and retry; otherwise admission stays FIFO —
+                # the head waits at the front for retirements to free
+                # capacity, and the queue filling up surfaces as
                 # QueueFull at the submit door (backpressure, by
                 # design never an over-committed pool).
                 self._queue.appendleft(request)
+                if self._try_preempt(request.priority):
+                    continue  # capacity freed; re-check the same head
                 break
             slot = self.engine.acquire_slot()
             assert slot is not None
             try:
-                start = self.engine.admit(slot, request.prompt,
-                                          request.max_new_tokens)
+                start = self.engine.admit(slot, prompt, budget)
             except PoolExhausted as exc:
                 # an injected allocation failure (chaos drill,
                 # `serve.pool` fault site) or headroom lost since the
@@ -316,7 +472,7 @@ class ContinuousBatchingScheduler:
                 self._queue.appendleft(request)
                 break
             if self.engine.cache_layout == "paged":
-                self.metrics.on_prefix(start, int(request.prompt.size))
+                self.metrics.on_prefix(start, int(prompt.size))
             request.slot = slot
             request.admitted_at = time.perf_counter()
             self.metrics.on_queue_wait(
@@ -326,23 +482,23 @@ class ContinuousBatchingScheduler:
             self.admitted_order.append(request.uid)
             admitted += 1
             if self.engine.chunk is None:
-                first = self.engine.prefill(slot, request.prompt)
+                first = self.engine.prefill(slot, prompt)
                 self._first_token(slot, request, first)
             else:
                 # prefill resumes where the prefix cache left off
                 # (start > 0 is a prefix hit: those tokens' K/V are
                 # shared by reference, never recomputed)
                 request.state = "prefilling"
-                self._prefilling[slot] = [request, start]
+                self._prefilling[slot] = [request, start, prompt]
         # advance chunked prefills, bounded per step (the stall bound)
         self.prefill_tokens_last_step = 0
         budget = self.prefill_chunks_per_step
         for slot in list(self._prefilling):
             if budget <= 0:
                 break
-            request, start = self._prefilling[slot]
+            request, start, prompt = self._prefilling[slot]
             new_start, first = self.engine.prefill_chunk(
-                slot, request.prompt, start)
+                slot, prompt, start)
             budget -= 1
             if self.tracing is not None:
                 self.tracing.on_prefill_chunk(request, start, new_start)
@@ -368,7 +524,8 @@ class ContinuousBatchingScheduler:
             self._draft_slots.discard(request.slot)
             self.draft.retire(request.slot)
         self.metrics.on_done(request.finished_at - request.submitted_at,
-                             reason)
+                             reason, tenant=request.tenant,
+                             tokens=len(request.generated))
         if self.tracing is not None:
             self.tracing.on_finish(request, reason)
         logger.debug("request %d done (%s): %d prompt + %d generated",

@@ -260,6 +260,43 @@ class RequestTracer:
             self.tracer.async_instant(SPAN_DECODE, record.uid,
                                       TRACE_CATEGORY, **args)
 
+    def on_preempt(self, request: tp.Any) -> None:
+        """A running request was evicted for a higher-priority admission
+        and re-queued: close the open phase span, reopen the queued
+        span, and reset the phase clocks so the eventual re-admission's
+        `on_admit`/`on_first_token` re-balance the span stack."""
+        record = self._inflight.get(request.uid)
+        if record is None:
+            return
+        if record.sampled and self.tracer is not None:
+            if record.first_token_at is not None:
+                self.tracer.async_end(SPAN_DECODE, record.uid,
+                                      TRACE_CATEGORY)
+            elif record.admitted_at is not None:
+                self.tracer.async_end(SPAN_PREFILL, record.uid,
+                                      TRACE_CATEGORY)
+            self.tracer.async_begin(SPAN_QUEUED, record.uid,
+                                    TRACE_CATEGORY, preempted=True)
+        self._journal_event(
+            "preempted", uid=record.uid,
+            tokens=len(getattr(request, "generated", ()) or ()),
+            priority=getattr(request, "priority", 0))
+        record.admitted_at = None
+        record.first_token_at = None
+
+    def on_handoff(self, request: tp.Any, src: str, dst: str) -> None:
+        """The request's KV state moved engines (disaggregated
+        prefill->decode handoff): an instant on the request span plus a
+        journal line naming both engines — the cross-engine hop is
+        exactly what a per-engine trace alone cannot attribute."""
+        record = self._inflight.get(request.uid)
+        if record is not None and record.sampled \
+                and self.tracer is not None:
+            self.tracer.async_instant(SPAN_REQUEST, record.uid,
+                                      TRACE_CATEGORY, handoff=True,
+                                      src=src, dst=dst)
+        self._journal_event("handoff", uid=request.uid, src=src, dst=dst)
+
     def on_finish(self, request: tp.Any, reason: str) -> None:
         """Request retired (eos/length), expired, or shed: close every
         open phase span and journal the summary. Slow unsampled

@@ -53,6 +53,9 @@ HEARTBEAT_DIR_NAME = "heartbeats"
 SERVE_STATUS_NAME = "serve.json"
 # Per-request lifecycle journal (flashy_tpu.serve.tracing.RequestTracer).
 REQUESTS_NAME = "requests.jsonl"
+# Fleet topology snapshot (flashy_tpu.serve.fleet.ServingFleet): which
+# engines exist, their roles/health/occupancy and per-engine SLO burn.
+FLEET_STATUS_NAME = "fleet.json"
 
 
 class Config(dict):

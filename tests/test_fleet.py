@@ -1,0 +1,371 @@
+# The serving fleet: deterministic prefix-sticky routing (replayable
+# across processes — the hash has no salt, so hard-coded values ARE
+# the cross-process test), per-tenant quotas + priority preemption
+# with a token-exact rollback, block-list handoff between engines
+# sharing one pool, and the engine-death re-route drill. Pool-side
+# primitives (evict_slot / transfer_slot) get conservation regression
+# tests of their own.
+import json
+
+import numpy as np
+import pytest
+
+from flashy_tpu.serve.fleet import (
+    ENGINE_FAULT_SITE, DisaggregatedPair, FleetRouter, QuotaManager,
+    ServingFleet, TenantQuota, fnv1a, hand_off,
+)
+from flashy_tpu.serve.paged import BlockPool
+
+
+# ----------------------------------------------------------------------
+# router determinism
+# ----------------------------------------------------------------------
+def test_fnv1a_is_salt_free_and_seedable():
+    # fixed constants: the same bytes hash identically in EVERY process
+    # (unlike Python's salted hash()) — this literal is the contract
+    assert fnv1a(b"abc") == 16654208175385433931
+    assert fnv1a(b"") == 14695981039346656037  # the FNV offset basis
+    assert fnv1a(b"abc", seed=1) != fnv1a(b"abc")
+    assert 0 <= fnv1a(b"abc", seed=7) < 1 << 64
+
+
+def test_sticky_route_is_deterministic_and_chain_keyed():
+    router = FleetRouter(["a", "b", "c"], block_size=4)
+    prompt = np.arange(10, dtype=np.int32)
+    decision = router.route(0, prompt)
+    # hard-coded: any process, any rerun, same (uid, chain key, fleet)
+    # must produce exactly this decision
+    assert decision.engine == "a"
+    assert decision.reason == "sticky"
+    assert decision.key_hash == 3519420321626719077
+    # the routing key is the FIRST FULL BLOCK (the PrefixIndex chain
+    # key), so a different tail beyond it routes identically...
+    tail = np.concatenate([prompt[:4], np.full(20, 63, np.int32)])
+    assert router.route(99, tail).engine == "a"
+    # ...and a different first block routes by ITS content
+    other = router.route(0, prompt + 1)
+    assert other.key_hash != decision.key_hash
+    # a fresh router replays identically (no per-instance state)
+    assert FleetRouter(["a", "b", "c"], block_size=4).route(
+        0, prompt) == decision
+
+
+def test_round_robin_and_health_filtering():
+    router = FleetRouter(["a", "b", "c"], block_size=4,
+                         policy="round_robin")
+    prompt = np.arange(6, dtype=np.int32)
+    assert [router.route(uid, prompt).engine
+            for uid in range(5)] == ["a", "b", "c", "a", "b"]
+    # dead engines leave the candidate ring; order is preserved
+    assert router.route(0, prompt, healthy=["b", "c"]).engine == "b"
+    with pytest.raises(RuntimeError, match="no healthy"):
+        router.route(0, prompt, healthy=[])
+    with pytest.raises(ValueError):
+        FleetRouter(["a", "a"], block_size=4)
+    with pytest.raises(ValueError):
+        FleetRouter(["a"], block_size=4, policy="nope")
+
+
+def test_slo_alerting_redirects_on_probe_ring():
+    router = FleetRouter(["a", "b", "c"], block_size=4)
+    prompt = np.arange(10, dtype=np.int32)  # sticky target: "a"
+    redirected = router.route(0, prompt, alerting={"a"})
+    assert redirected.engine == "b"
+    assert redirected.reason == "slo_redirect"
+    # every candidate burning: the router keeps the original target
+    # (the admission door sheds, the router only places)
+    kept = router.route(0, prompt, alerting={"a", "b", "c"})
+    assert kept.engine == "a" and kept.reason == "sticky"
+
+
+# ----------------------------------------------------------------------
+# quotas
+# ----------------------------------------------------------------------
+def test_quota_manager_caps_and_sheds():
+    quotas = QuotaManager({"vip": TenantQuota(max_inflight=2, priority=5)},
+                          default=TenantQuota(max_inflight=1))
+    assert quotas.quota_for("vip").priority == 5
+    assert quotas.quota_for("other").max_inflight == 1
+    assert quotas.try_acquire("vip") and quotas.try_acquire("vip")
+    assert not quotas.try_acquire("vip")  # at cap -> shed
+    assert quotas.shed["vip"] == 1
+    quotas.release("vip")
+    assert quotas.try_acquire("vip")  # credit returned
+    with pytest.raises(ValueError, match="release without acquire"):
+        quotas.release("never-seen")
+    with pytest.raises(ValueError):
+        TenantQuota(max_inflight=0)
+    summary = quotas.summary()
+    assert summary["vip"] == {"inflight": 2, "max_inflight": 2, "shed": 1}
+
+
+def test_request_tenant_and_priority_validation():
+    from tests.test_serve import _tiny_model
+    from flashy_tpu.serve import ContinuousBatchingScheduler, DecodeEngine
+
+    model, params = _tiny_model()
+    scheduler = ContinuousBatchingScheduler(
+        DecodeEngine(model, params, slots=2))
+    prompt = np.arange(4, dtype=np.int32) % 32
+    with pytest.raises(ValueError, match="tenant"):
+        scheduler.submit(prompt, 2, tenant="")
+    with pytest.raises(ValueError, match="priority"):
+        scheduler.submit(prompt, 2, priority=True)  # bool is not a class
+    with pytest.raises(ValueError, match="priority"):
+        scheduler.submit(prompt, 2, priority="high")
+    handle = scheduler.submit(prompt, 2, tenant="acme", priority=3)
+    assert handle.tenant == "acme" and handle.priority == 3
+
+
+# ----------------------------------------------------------------------
+# pool primitives: evict_slot / transfer_slot
+# ----------------------------------------------------------------------
+def test_evict_slot_conserves_pool():
+    pool = BlockPool(num_blocks=9, block_size=4, max_seq_len=16,
+                     prefix_cache=False)
+    prompt = np.arange(6, dtype=np.int32)
+    plan = pool.plan(prompt, max_new_tokens=4)
+    pool.commit(plan, slot=0)
+    held = pool.free_blocks
+    pool.check()
+    freed = pool.evict_slot(0)
+    assert freed and not pool.holds(0)
+    assert pool.free_blocks > held  # the reservation came back
+    assert pool.stats()["preemptions"] == 1
+    pool.check()  # conservation invariant after the eviction
+    with pytest.raises(KeyError):
+        pool.evict_slot(0)  # double eviction is a bug, not a no-op
+
+
+def test_transfer_slot_rekeys_without_touching_blocks():
+    pool = BlockPool(num_blocks=9, block_size=4, max_seq_len=16,
+                     prefix_cache=False)
+    prompt = np.arange(6, dtype=np.int32)
+    pool.commit(pool.plan(prompt, max_new_tokens=4), slot=3)
+    blocks = list(pool.slot_blocks(3))
+    free_before = pool.free_blocks
+    moved = pool.transfer_slot(3, 7)
+    assert list(moved) == blocks  # same physical blocks, new key
+    assert pool.holds(7) and not pool.holds(3)
+    assert list(pool.slot_blocks(7)) == blocks
+    assert pool.free_blocks == free_before  # re-key is not a release
+    assert pool.stats()["handoffs"] == 1
+    pool.check()
+    with pytest.raises(KeyError):
+        pool.transfer_slot(3, 8)  # src gone
+    pool.commit(pool.plan(prompt, max_new_tokens=4), slot=1)
+    with pytest.raises(ValueError, match="already"):
+        pool.transfer_slot(1, 7)  # dst occupied
+
+
+# ----------------------------------------------------------------------
+# SLO budget sets
+# ----------------------------------------------------------------------
+def test_engine_budget_sets_are_independent():
+    from flashy_tpu.observability import engine_budget_sets
+
+    slos = engine_budget_sets(["e0", "e1"])
+    assert set(slos) == {"e0", "e1"}
+    for _ in range(16):
+        slos["e0"].observe("ttft", 9.0, now=100.0)
+    assert slos["e0"].alerts(now=100.0)
+    assert not slos["e1"].alerts(now=100.0)  # e1 saw nothing
+    with pytest.raises(ValueError):
+        engine_budget_sets(["dup", "dup"])
+    with pytest.raises(ValueError):
+        engine_budget_sets([])
+
+
+# ----------------------------------------------------------------------
+# end-to-end: handoff / preemption / death (slow: real engines)
+# ----------------------------------------------------------------------
+def _fleet_model(vocab=32, max_seq_len=32):
+    from tests.test_serve import _tiny_model
+    return _tiny_model(vocab=vocab, max_seq_len=max_seq_len)
+
+
+@pytest.mark.slow
+def test_disaggregated_handoff_token_exact():
+    from flashy_tpu.models.decoding import generate
+
+    model, params = _fleet_model()
+    pair = DisaggregatedPair(model, params, prefill_slots=2,
+                             decode_slots=3, block_size=4,
+                             kernel="gather")
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 32, n).astype(np.int32)
+               for n in (3, 5, 8, 6, 4, 9)]
+    pair.warmup(prompt_lengths=[len(p) for p in prompts])
+    outputs = pair.serve(prompts, max_new_tokens=5)
+    for prompt, out in zip(prompts, outputs):
+        want = np.asarray(generate(model, params, prompt[None],
+                                   max_new_tokens=5))[0]
+        got = np.concatenate([prompt, np.asarray(out, np.int32)])
+        np.testing.assert_array_equal(got, want)
+    assert len(pair.handoffs) == len(prompts)
+    assert all(p.src == "prefill" and p.dst == "decode"
+               for p in pair.handoffs)
+    pair.pool.check()
+
+
+@pytest.mark.slow
+def test_hand_off_requires_shared_pool():
+    model, params = _fleet_model()
+    a = DisaggregatedPair(model, params, prefill_slots=1, decode_slots=1,
+                          block_size=4, kernel="gather")
+    b = DisaggregatedPair(model, params, prefill_slots=1, decode_slots=1,
+                          block_size=4, kernel="gather")
+    slot = a.prefill.acquire_slot()
+    a.prefill.admit(slot, np.arange(4, dtype=np.int32), 2)
+    with pytest.raises(ValueError, match="share one"):
+        hand_off(a.prefill, b.decode, slot)  # different pools
+
+
+@pytest.mark.slow
+def test_priority_preemption_resumes_token_exact(tmp_path):
+    from flashy_tpu.models.decoding import generate
+    from flashy_tpu.xp import SERVE_STATUS_NAME
+
+    model, params = _fleet_model()
+    quotas = QuotaManager({
+        "batch": TenantQuota(max_inflight=8, priority=0),
+        "vip": TenantQuota(max_inflight=8, priority=5)})
+    fleet = ServingFleet.build(model, params, engines=1, slots=2,
+                               block_size=4, kernel="gather",
+                               quotas=quotas)
+    rng = np.random.default_rng(1)
+    low_prompts = [rng.integers(0, 32, 4 + i).astype(np.int32)
+                   for i in range(3)]
+    vip_prompt = rng.integers(0, 32, 5).astype(np.int32)
+    fleet.warmup(prompt_lengths=[4, 5, 6])
+    low = [fleet.submit(p, 10, tenant="batch") for p in low_prompts]
+    member = next(iter(fleet.members.values()))
+    for _ in range(3):
+        fleet.step()
+        member.engine.pool.check()
+    vip = fleet.submit(vip_prompt, 6, tenant="vip")
+    fleet.run()
+
+    assert sum(h.preemptions for h in low) >= 1  # someone was evicted
+    assert member.engine.pool.stats()["preemptions"] >= 1
+    for prompt, handle in zip(low_prompts + [vip_prompt], low + [vip]):
+        want = np.asarray(generate(
+            model, params, prompt[None],
+            max_new_tokens=handle.max_new_tokens))[0]
+        np.testing.assert_array_equal(handle.output, want)
+    member.engine.pool.check()
+    # per-tenant rollups land in serve.json
+    member.scheduler.metrics.write_status(tmp_path)
+    with open(tmp_path / SERVE_STATUS_NAME) as f:
+        tenants = json.load(f)["tenants"]
+    assert tenants["batch"]["preempted"] >= 1
+    assert tenants["vip"]["completed"] == 1
+    assert tenants["batch"]["tokens"] == sum(len(h.generated) for h in low)
+
+
+@pytest.mark.slow
+def test_sticky_beats_round_robin_on_shared_prefix():
+    model, params = _fleet_model()
+    rng = np.random.default_rng(2)
+    system = rng.integers(0, 32, 4).astype(np.int32)  # one full block
+    prompts = []
+    for i in range(16):
+        tail = rng.integers(0, 32, 2 + i % 3).astype(np.int32)
+        prompts.append(np.concatenate([system, tail])
+                       if i % 2 == 0 else tail)
+
+    def hit_counters(policy):
+        fleet = ServingFleet.build(
+            model, params, engines=2, slots=2, block_size=4,
+            kernel="gather", policy=policy,
+            quotas=QuotaManager(default=TenantQuota(max_inflight=32)))
+        fleet.warmup(prompt_lengths=[len(p) for p in prompts])
+        for prompt in prompts:
+            fleet.submit(prompt, 3)
+        fleet.run()
+        matched = total = 0
+        for member in fleet.members.values():
+            metrics = member.scheduler.metrics
+            matched += metrics.prefix_matched_tokens
+            total += metrics.prefix_prompt_tokens
+        return matched / max(total, 1)
+
+    assert hit_counters("sticky") >= hit_counters("round_robin")
+
+
+@pytest.mark.slow
+def test_engine_death_reroutes_token_exact(tmp_path):
+    from flashy_tpu.models.decoding import generate
+    from flashy_tpu.resilience import chaos
+    from flashy_tpu.xp import FLEET_STATUS_NAME
+
+    model, params = _fleet_model()
+    fleet = ServingFleet.build(
+        model, params, engines=2, slots=3, block_size=4, kernel="gather",
+        quotas=QuotaManager(default=TenantQuota(max_inflight=32)))
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 32, 3 + i % 5).astype(np.int32)
+               for i in range(6)]
+    fleet.warmup(prompt_lengths=[len(p) for p in prompts])
+    handles = [fleet.submit(p, 5) for p in prompts]
+    for _ in range(2):
+        fleet.step()
+    victim = fleet.healthy[0]
+    mid_flight = fleet.members[victim].scheduler.live_count
+    assert mid_flight >= 1  # the drill must kill a BUSY engine
+
+    injector = chaos.install(strict=True)
+    injector.fail_at(ENGINE_FAULT_SITE, call=1)
+    try:
+        fleet.run()
+    finally:
+        chaos.uninstall()  # strict: raises if the kill never fired
+    assert injector.hits(ENGINE_FAULT_SITE) == 1
+    assert fleet.deaths == [victim]
+    assert fleet.reroutes >= mid_flight
+
+    for prompt, handle in zip(prompts, handles):
+        assert handle.done
+        want = np.asarray(generate(model, params, prompt[None],
+                                   max_new_tokens=5))[0]
+        np.testing.assert_array_equal(handle.output, want)
+    for name, member in fleet.members.items():
+        if member.healthy:
+            member.engine.pool.check()
+    # fleet.json records the death and renders through info
+    from flashy_tpu.info import format_fleet_status
+    fleet.write_status(tmp_path)
+    with open(tmp_path / FLEET_STATUS_NAME) as f:
+        status = json.load(f)
+    assert status["deaths"] == [victim]
+    assert not status["engines"][victim]["healthy"]
+    rendered = format_fleet_status(status)
+    assert "DEAD" in rendered and "deaths[" in rendered
+
+
+@pytest.mark.slow
+def test_fleet_quota_sheds_at_the_door():
+    model, params = _fleet_model()
+    fleet = ServingFleet.build(
+        model, params, engines=1, slots=2, block_size=4, kernel="gather",
+        quotas=QuotaManager(default=TenantQuota(max_inflight=2)))
+    fleet.warmup(prompt_lengths=[4])
+    from flashy_tpu.serve import QueueFull
+
+    prompt = np.arange(4, dtype=np.int32)
+    fleet.submit(prompt, 2)
+    fleet.submit(prompt, 2)
+    with pytest.raises(QueueFull, match="quota"):
+        fleet.submit(prompt, 2)
+    assert fleet.quotas.shed["default"] == 1
+    fleet.run()  # finishing returns the credits
+    fleet.submit(prompt, 2)  # no longer over quota
+    fleet.run()
+
+
+@pytest.mark.slow
+def test_fleet_demo_entrypoint_smoke(caplog):
+    from flashy_tpu.serve.fleet.__main__ import main
+
+    with caplog.at_level("INFO"):
+        assert main(["-n", "4", "--legs", "handoff"]) == 0
