@@ -141,6 +141,22 @@ fleet-demo:
 chaos-demo:
 	JAX_PLATFORMS=cpu python -m flashy_tpu.resilience --epochs 5
 
+# Registry-driven chaos campaign on 8 virtual CPU devices: every FT003
+# fault site swept under at least one seeded fault schedule (transient
+# raise / fatal kill / latency stall / on-disk corruption, as each
+# site's scenario declares), driven through the real train / datapipe /
+# serve / fleet / pipeline / elastic workloads with their invariant
+# oracles (token-exactness vs generate(), pool conservation,
+# checkpoint restorability, strict all-armed-faults-fired, WAL restart
+# dedup). Exit 1 on any oracle failure (the failing schedule is
+# ddmin-shrunk to campaign_repro.json — replay it with
+# `python -m flashy_tpu.resilience --campaign --replay <artifact>`)
+# or on incomplete registry coverage. A few minutes; also run by the
+# tests workflow.
+chaos-campaign:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m flashy_tpu.resilience --campaign --seed 0
+
 # ZeRO-1 sharded-weight-update demo on 8 virtual CPU devices: replicated
 # vs zero1 vs fsdp step time + per-chip optimizer HBM, exit 1 on any
 # numeric drift from the replicated path or any post-warm-up recompile.
@@ -203,4 +219,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo serve-slo-demo fleet-demo chaos-demo elastic-demo zero-demo pipeline-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo serve-slo-demo fleet-demo chaos-demo chaos-campaign elastic-demo zero-demo pipeline-demo datapipe-demo docs native dist

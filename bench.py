@@ -84,13 +84,14 @@ DETAIL_PATH = os.path.join(_STATE_DIR, "BENCH_DETAIL.json")
 # Budget for the single stdout JSON line: the driver records only a
 # ~2,000-char tail of stdout, so the line must stay comfortably inside
 # it (r3's multi-KB line made BENCH_r03.json parse as null).
-# 1800 still clears the ~2,000-char driver tail (plus the ~100-char
-# metric prefix) with ~100 chars of margin; raised from 1500 when the
-# pipeline leg became the 13th compact entry, from 1600 when it grew
-# the three packed-schedule aliases, and from 1700 when the roofline
-# leg became the 14th compact entry (worst case measured 1720 by
+# 1850 still clears the ~2,000-char driver tail (plus the ~100-char
+# metric prefix); raised from 1500 when the pipeline leg became the
+# 13th compact entry, from 1600 when it grew the three
+# packed-schedule aliases, from 1700 when the roofline leg became the
+# 14th compact entry, and from 1800 when the recovery leg became the
+# 16th (worst case measured 1812 by
 # test_compact_line_fits_driver_tail_worst_case).
-MAX_LINE_CHARS = 1800
+MAX_LINE_CHARS = 1850
 
 # Peak bf16 matmul FLOP/s per chip, by device_kind substring (public
 # cloud.google.com/tpu/docs numbers).
@@ -1036,6 +1037,124 @@ def bench_fleet(jax, on_tpu: bool):
     return result
 
 
+def bench_recovery(jax, on_tpu: bool):
+    """Crash-recovery cost for the durable request WAL: journaling
+    overhead on the serving hot path (same burst with and without a
+    WAL attached), then a mid-decode crash at 1/2/4 engines — WAL
+    replay latency, drain time for the re-admitted requests, and the
+    fraction of final tokens that had to be re-derived from the
+    journal (the at-least-once re-serve cost)."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+    from flashy_tpu.serve.fleet import (QuotaManager, RequestWAL,
+                                        ServingFleet, TenantQuota)
+
+    if on_tpu:
+        dim, layers, heads, vocab = 512, 4, 8, 4096
+        slots, new_tokens, requests, kill_steps = 8, 32, 64, 8
+    else:
+        dim, layers, heads, vocab = 128, 2, 4, 512
+        slots, new_tokens, requests, kill_steps = 4, 12, 16, 4
+    cfg = TransformerConfig(vocab_size=vocab, dim=dim, num_layers=layers,
+                            num_heads=heads, attention="dense",
+                            max_seq_len=64,
+                            dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    params = {"params": model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]}
+    prompts = [rng.integers(0, vocab, 4 + i % 6).astype(np.int32)
+               for i in range(requests)]
+    # recovered requests prefill prompt+replayed-tokens, so every
+    # integer length up to len(p)+new_tokens must have a warm bucket
+    lengths = sorted({n for p in prompts
+                      for n in range(len(p), len(p) + new_tokens + 1)})
+    workdir = tempfile.mkdtemp(prefix="bench_recovery_")
+
+    def build(engines, wal_path=None):
+        return ServingFleet.build(
+            model, params, engines=engines, slots=slots, block_size=16,
+            max_queue=4 * requests,
+            kernel="fused" if on_tpu else "gather",
+            quotas=QuotaManager(default=TenantQuota(
+                max_inflight=2 * requests)),
+            wal=RequestWAL(wal_path) if wal_path else None)
+
+    def serve_all(fleet):
+        fleet.warmup(prompt_lengths=[len(p) for p in prompts])
+        begin = time.perf_counter()
+        handles = [fleet.submit(p, new_tokens) for p in prompts]
+        fleet.run()
+        return time.perf_counter() - begin, handles
+
+    result = {}
+    # journaling overhead: identical warmed burst, WAL off vs WAL on
+    # (the admit fsync + per-step progress marks are the difference).
+    # One discarded burst first: process-level caches warm for BOTH
+    # timed runs, or the plain one eats the bias
+    serve_all(build(1))
+    plain_s, _ = serve_all(build(1))
+    fleet = build(1, os.path.join(workdir, "overhead.wal"))
+    walled_s, _ = serve_all(fleet)
+    fleet.wal.close()
+    overhead = (walled_s - plain_s) / plain_s * 100
+    result["wal_append_overhead_pct"] = round(overhead, 1)
+    log(f"recovery: WAL journaling overhead {overhead:+.1f}% "
+        f"({walled_s * 1e3:.0f}ms vs {plain_s * 1e3:.0f}ms burst)")
+
+    per_engines = {}
+    for engines in (1, 2, 4):
+        wal_path = os.path.join(workdir, f"crash_{engines}e.wal")
+        fleet = build(engines, wal_path)
+        fleet.warmup(prompt_lengths=lengths)
+        for prompt in prompts:
+            fleet.submit(prompt, new_tokens)
+        for _ in range(kill_steps):
+            fleet.step()  # mid-decode "crash": journal survives, state dies
+        fleet.wal.close()
+        del fleet
+
+        fleet = build(engines, wal_path)
+        fleet.warmup(prompt_lengths=lengths)
+        begin = time.perf_counter()
+        rec = fleet.recover_from_wal()
+        replay_s = time.perf_counter() - begin
+        replayed = sum(len(r.generated) for r in rec["recovered"].values())
+        replayed += sum(len(e.generated) for e in rec["completed"].values())
+        begin = time.perf_counter()
+        fleet.run()
+        drain_s = time.perf_counter() - begin
+        fleet.wal.close()
+        total = sum(len(r.generated) for r in rec["recovered"].values())
+        total += sum(len(e.generated) for e in rec["completed"].values())
+        entry = {"wal_replay_ms": round(replay_s * 1e3, 1),
+                 "recovery_drain_ms": round(drain_s * 1e3, 1),
+                 "reserved_token_frac": round(replayed / max(total, 1), 3),
+                 "wal_bytes": os.path.getsize(wal_path),
+                 "recovered": len(rec["recovered"]),
+                 "completed_from_log": len(rec["completed"])}
+        per_engines[engines] = entry
+        log(f"recovery x{engines}: replay {entry['wal_replay_ms']:.0f}ms "
+            f"({entry['wal_bytes']}B journal), drain "
+            f"{entry['recovery_drain_ms']:.0f}ms, "
+            f"{entry['recovered']} re-admitted + "
+            f"{entry['completed_from_log']} answered from the log, "
+            f"{entry['reserved_token_frac'] * 100:.0f}% of tokens "
+            f"re-derived")
+    shutil.rmtree(workdir, ignore_errors=True)
+    result["engines"] = per_engines
+    result.update({
+        "wal_replay_ms": per_engines[4]["wal_replay_ms"],
+        "recovery_drain_ms": per_engines[4]["recovery_drain_ms"],
+        "reserved_token_frac": per_engines[4]["reserved_token_frac"],
+    })
+    return result
+
+
 def bench_roofline(jax, on_tpu: bool):
     """Per-executable roofline from XLA `cost_analysis` over measured
     wall time (observability.RooflineProfiler): realized MFU for the LM
@@ -1594,6 +1713,8 @@ _COMPACT_KEYS = {
                "fused_vs_gather", "kv_read_bytes_per_token"),
     "fleet": ("tokens_per_sec_per_chip", "scaling_2e", "scaling_4e",
               "shed_rate", "ttft_ms_p95"),
+    "recovery": ("wal_replay_ms", "recovery_drain_ms",
+                 "reserved_token_frac", "wal_append_overhead_pct"),
     "host_sync": ("gib_per_sec",),
     "all_reduce": ("bus_bandwidth_gb_s",),
     "roofline": ("lm_mfu", "lm_tflops_per_sec",
@@ -1686,7 +1807,8 @@ _LEGS_FILTER = os.environ.get("FLASHY_TPU_BENCH_LEGS")
 LEG_ORDER = tuple(
     name for name in ("smoke", "mxu", "cifar", "lm", "attention", "zero",
                       "pipeline", "ring", "gan", "decode", "fleet",
-                      "roofline", "datapipe", "host_sync", "all_reduce")
+                      "recovery", "roofline", "datapipe", "host_sync",
+                      "all_reduce")
     if _LEGS_FILTER is None or name in _LEGS_FILTER.split(","))
 
 
@@ -1746,6 +1868,7 @@ def child_main() -> None:
         "ring": lambda: bench_ring(jax, on_tpu),
         "decode": lambda: bench_decode(jax, on_tpu),
         "fleet": lambda: bench_fleet(jax, on_tpu),
+        "recovery": lambda: bench_recovery(jax, on_tpu),
         "roofline": lambda: bench_roofline(jax, on_tpu),
         "gan": lambda: bench_gan(jax, on_tpu),
         "datapipe": lambda: bench_datapipe(jax, on_tpu),

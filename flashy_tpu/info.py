@@ -4,6 +4,7 @@
 """`python -m flashy_tpu.info [root]`: list experiments and their status."""
 import argparse
 import json
+import typing as tp
 from pathlib import Path
 
 
@@ -271,6 +272,80 @@ def _saved_topology(folder: Path):
                                folder / CHECKPOINT_META_NAME)
 
 
+def fault_site_report(strict: bool = False) -> int:
+    """Print every fault-injection site with its owning module(s) and
+    chaos-campaign coverage status; the operator's one-stop answer to
+    "which failure paths does this tree actually exercise?".
+
+    Columns: site, the module(s) whose `fault_point` call fires it
+    (from the same AST scan FT003 generates the registry from), and
+    one of `covered` (some campaign scenario declares it, with the
+    fault kinds it sweeps), `noqa'd` (deliberately excluded, reason
+    shown) or `UNCOVERED`. Sites the scan finds that the committed
+    registry is missing are flagged `unregistered` (the FT003 gate
+    fails on those too). Exit 1 with `strict=True` when anything is
+    UNCOVERED or unregistered — the CI form of the coverage promise.
+    """
+    from .analysis.core import discover_files, extract_fault_sites
+    from .analysis.registry import FAULT_SITES, FAULT_SITE_PREFIXES
+    from .resilience.campaign import NOQA_SITES, static_coverage
+
+    pkg = Path(__file__).resolve().parent
+    owners: dict = {}
+    for file in discover_files([pkg], pkg.parent):
+        sites, prefixes = extract_fault_sites(file)
+        module = file.rel[:-len(".py")].replace("/", ".")
+        if module.endswith(".__init__"):
+            module = module[:-len(".__init__")]
+        for site in set(sites) | {f"{p}*" for p in prefixes}:
+            owners.setdefault(site, []).append(module)
+
+    coverage = static_coverage()
+
+    def status(site: str) -> tp.Tuple[str, bool]:
+        """(display status, counts-as-covered)."""
+        if site in NOQA_SITES:
+            return f"noqa'd: {NOQA_SITES[site]}", True
+        if site.endswith("*"):  # dynamic prefix site (e.g. logger.*)
+            hits = {s: by for s, by in coverage.items()
+                    if s.startswith(site[:-1])}
+            if hits:
+                names = sorted({name for by in hits.values()
+                                for name in by})
+                return (f"covered via {', '.join(sorted(hits))} "
+                        f"[{', '.join(names)}]"), True
+            return "UNCOVERED", False
+        if site in coverage:
+            parts = [f"{name}({','.join(kinds)})"
+                     for name, kinds in sorted(coverage[site].items())]
+            return "covered by " + " ".join(parts), True
+        return "UNCOVERED", False
+
+    registered = set(FAULT_SITES) | {f"{p}*" for p in FAULT_SITE_PREFIXES}
+    rows = []
+    bad = 0
+    for site in sorted(registered | set(owners)):
+        verdict, ok = status(site)
+        if site not in registered:
+            verdict = ("unregistered — run `python -m flashy_tpu."
+                       "analysis --write-registry`")
+            ok = False
+        if not ok:
+            bad += 1
+        rows.append((site, ", ".join(sorted(set(owners.get(site, []))))
+                     or "?", verdict))
+    width_site = max(len(r[0]) for r in rows)
+    width_owner = max(len(r[1]) for r in rows)
+    for site, owner, verdict in rows:
+        print(f"{site:<{width_site}}  {owner:<{width_owner}}  {verdict}")
+    if bad:
+        print(f"\n{bad} site(s) without campaign coverage — add them to "
+              "a scenario's sites() in flashy_tpu/resilience/campaign.py "
+              "(or NOQA_SITES with a reason)")
+        return 1 if strict else 0
+    return 0
+
+
 def format_device_stats() -> str:
     """Live per-device HBM occupancy of THIS host's devices.
 
@@ -312,7 +387,17 @@ def main(argv=None) -> int:
                              "for every XP; exit 1 when any XP's checkpoints "
                              "have no restorable source left (or when no "
                              "experiments exist under the root)")
+    parser.add_argument("--faults", action="store_true",
+                        help="list every fault-injection site with its "
+                             "owning module and chaos-campaign coverage "
+                             "status (covered / uncovered / noqa'd)")
+    parser.add_argument("--strict", action="store_true",
+                        help="with --faults: exit 1 when any site is "
+                             "uncovered or unregistered")
     args = parser.parse_args(argv)
+
+    if args.faults:
+        return fault_site_report(strict=args.strict)
 
     if args.verify_checkpoint:
         return verify_checkpoints(Path(args.root))

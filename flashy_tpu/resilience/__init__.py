@@ -43,6 +43,10 @@ from .chaos import (  # noqa
     uninstall,
 )
 from .hang import EXIT_HUNG, HangWatchdog  # noqa
+from .campaign import (  # noqa  (imports `chaos` above: keep this last)
+    CampaignFailure, FaultSpec, Schedule, builtin_scenarios, ddmin,
+    replay_artifact, run_campaign, static_coverage,
+)
 
 __all__ = [
     "EXIT_PREEMPTED", "EXIT_HUNG", "PreemptionGuard", "PreemptionInterrupt",
@@ -56,4 +60,6 @@ __all__ = [
     "uninstall", "get_injector",
     "fault_point", "corrupt_file", "corrupt_active_slot", "stall_heartbeat",
     "HangWatchdog",
+    "CampaignFailure", "FaultSpec", "Schedule", "builtin_scenarios",
+    "ddmin", "static_coverage", "run_campaign", "replay_artifact",
 ]

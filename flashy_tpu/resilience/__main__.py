@@ -618,6 +618,32 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                              "topology chaos drill")
     parser.add_argument("-s", "--steps", type=int, default=3,
                         help="steps per epoch for the elastic drill")
+    parser.add_argument("--campaign", action="store_true",
+                        help="run the registry-driven chaos campaign: "
+                             "every fault site x kind swept under seeded "
+                             "schedules, failures ddmin-shrunk to a JSON "
+                             "reproducer")
+    parser.add_argument("--replay", metavar="ARTIFACT", default=None,
+                        help="with --campaign: replay a minimized "
+                             "reproducer artifact instead of sweeping "
+                             "(exit 1 = reproduced)")
+    parser.add_argument("--budget", type=int, default=None,
+                        help="with --campaign: cap on fault schedules; "
+                             "below base coverage drops schedules LOUDLY "
+                             "(and fails the coverage gate), above it adds "
+                             "seeded multi-fault schedules")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="with --campaign: schedule-generation seed")
+    parser.add_argument("--scenarios", default=None,
+                        help="with --campaign: comma-separated scenario "
+                             "subset (narrows the coverage gate)")
+    parser.add_argument("--seeded-defect", default=None,
+                        help="with --campaign: activate a registered "
+                             "defect to prove the engine catches and "
+                             "shrinks it (exit 1 + artifact expected)")
+    parser.add_argument("--artifact", default=None,
+                        help="with --campaign: where to write the "
+                             "minimized reproducer on failure")
     parser.add_argument("--dir", default=None,
                         help="work directory (default: a fresh temp dir)")
     parser.add_argument("--keep", action="store_true",
@@ -626,6 +652,17 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="[%(levelname)s] %(message)s")
+    if args.campaign or args.replay:
+        from .campaign import replay_artifact, run_campaign
+        if args.replay:
+            return replay_artifact(args.replay, root=args.dir,
+                                   keep=args.keep)
+        scenarios = (args.scenarios.split(",")
+                     if args.scenarios else None)
+        return run_campaign(seed=args.seed, budget=args.budget,
+                            scenarios=scenarios,
+                            defect=args.seeded_defect, root=args.dir,
+                            keep=args.keep, artifact=args.artifact)
     if args.elastic:
         return run_elastic_drill(steps=args.steps, root=args.dir,
                                  keep=args.keep)

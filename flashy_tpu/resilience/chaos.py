@@ -20,6 +20,11 @@ from ..utils import AnyPath
 
 logger = logging.getLogger(__name__)
 
+# Injected-latency faults sleep through this hook so tests (and the
+# campaign's calibration runs) can stub the stall without stubbing the
+# global clock.
+_sleep: tp.Callable[[float], None] = time.sleep
+
 
 class InjectedFault(OSError):
     """The default exception an injection rule raises.
@@ -46,7 +51,7 @@ class _Rule:
     first_call: int           # 1-based occurrence that triggers the rule
     times: int                # consecutive occurrences it stays armed for
     action: tp.Callable[[], None]
-    kind: str                 # 'fail' | 'preempt' | 'act' (for the log)
+    kind: str                 # 'fail' | 'preempt' | 'act' | 'delay'
     fired_count: int = 0      # occurrences at which this rule triggered
 
     def armed_for(self, call: int) -> bool:
@@ -117,6 +122,25 @@ class FaultInjector:
                times: int = 1) -> None:
         """Run an arbitrary `action` at the `call`-th occurrence of `site`."""
         self._rules.append(_Rule(site, call, times, action, "act"))
+
+    def delay_at(self, site: str, call: int, seconds: float,
+                 times: int = 1) -> None:
+        """Stall the `call`-th occurrence of `site` for `seconds`.
+
+        The latency fault: nothing raises, nothing is lost — the site
+        just takes `seconds` longer. This is the fault the SLO burn-rate
+        engine and the retry layer's wall-clock `deadline=` exist for;
+        a drill that only ever injects crisp exceptions never meets it.
+        Sleeps through the module-level `_sleep` hook so tests can stub
+        the stall to zero wall-clock.
+        """
+        if seconds < 0:
+            raise ValueError(f"delay seconds must be >= 0, got {seconds}")
+
+        def action() -> None:
+            _sleep(seconds)
+
+        self._rules.append(_Rule(site, call, times, action, "delay"))
 
     # ------------------------------------------------------------------
     # the hook
@@ -217,8 +241,14 @@ def fault_point(site: str, **context: tp.Any) -> None:
     drill's ``drill.step``, the elastic drill's ``drill.elastic_step``,
     the datapipe drill's ``datapipe.batch`` (one tick per consumed
     packed batch — the mid-stream kill point of ``python -m
-    flashy_tpu.datapipe``), and ``datapipe.resplit`` (the world-size
-    cursor re-partition of an elastic resume).
+    flashy_tpu.datapipe``), ``datapipe.resplit`` (the world-size
+    cursor re-partition of an elastic resume), the serving fleet's
+    ``fleet.engine_step`` (engine death), ``fleet.wal_append`` /
+    ``fleet.wal_replay`` (the durable request WAL's write and restart
+    paths), ``fleet.status`` (inside the fleet.json / serve.json
+    atomic write, between tmp-write and rename), and the chaos
+    campaign's own ``campaign.run`` (one tick per scenario execution,
+    absorbed by the campaign's deadline-capped retry).
     """
     if _injector is not None:
         _injector.tick(site, **context)

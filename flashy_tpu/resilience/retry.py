@@ -23,8 +23,11 @@ logger = logging.getLogger(__name__)
 _jitter_rng = random.Random()
 
 # Module-level so tests can stub the wait out; `sleep=None` arguments
-# resolve here at call time.
+# resolve here at call time. `_clock` is the wall-clock the `deadline=`
+# cap reads — stubbed together with `_sleep` in tests so a simulated
+# stall consumes simulated budget.
 _sleep = time.sleep
+_clock = time.monotonic
 
 
 def backoff_delay(attempt: int, base_delay: float, max_delay: float,
@@ -59,6 +62,8 @@ def call_with_retry(fn: tp.Callable, *args: tp.Any,
                     name: tp.Optional[str] = None,
                     on_exhausted: str = "raise",
                     sleep: tp.Optional[tp.Callable[[float], None]] = None,
+                    deadline: tp.Optional[float] = None,
+                    clock: tp.Optional[tp.Callable[[], float]] = None,
                     **kwargs: tp.Any) -> tp.Any:
     """Call `fn(*args, **kwargs)`, retrying declared-transient failures.
 
@@ -69,28 +74,47 @@ def call_with_retry(fn: tp.Callable, *args: tp.Any,
     `'warn'` logs a warning and returns None (best-effort IO such as
     metric logging backends). Every failed attempt is WARNed and
     journaled through the active telemetry Tracer as a `retry` record.
+
+    `deadline=` caps TOTAL wall-clock seconds across all attempts,
+    alongside the attempt cap: when the elapsed time plus the next
+    backoff delay would cross it, retrying stops and the failure is
+    treated as exhausted (honoring `on_exhausted`). An attempt cap
+    alone cannot bound a drill — with injected latency (`delay_at`) or
+    slow failures, 4 attempts of capped-but-jittered backoff can stall
+    far past a budget; the deadline makes the worst case explicit.
     """
     if attempts < 1:
         raise ValueError(f"attempts must be >= 1, got {attempts}")
+    if deadline is not None and deadline <= 0:
+        raise ValueError(f"deadline must be > 0 seconds, got {deadline}")
     if on_exhausted not in ("raise", "warn"):
         raise ValueError(f"on_exhausted must be 'raise' or 'warn', "
                          f"got {on_exhausted!r}")
     site = name or getattr(fn, "__qualname__", repr(fn))
+    now = clock or _clock
+    start = now()
     for attempt in range(1, attempts + 1):
         try:
             return fn(*args, **kwargs)
         except retry_on as exc:
-            last = attempt == attempts
+            delay = backoff_delay(attempt, base_delay, max_delay, jitter)
+            over_deadline = (deadline is not None
+                             and now() - start + delay > deadline)
+            last = attempt == attempts or over_deadline
             _note_attempt(site, attempt, attempts, exc,
                           "exhausted" if last else "retrying")
             if last:
+                why = (f"deadline {deadline:.2f}s exhausted after "
+                       f"{now() - start:.2f}s" if over_deadline
+                       else f"failed {attempt}/{attempts} attempts")
                 if on_exhausted == "warn":
                     logger.warning(
-                        "%s failed %d/%d attempts; degrading to a warning "
-                        "(last error: %s)", site, attempt, attempts, exc)
+                        "%s %s; degrading to a warning (last error: %s)",
+                        site, why, exc)
                     return None
+                if over_deadline:
+                    logger.warning("%s %s; raising", site, why)
                 raise
-            delay = backoff_delay(attempt, base_delay, max_delay, jitter)
             logger.warning("%s failed (attempt %d/%d): %s — retrying in %.2fs",
                            site, attempt, attempts, exc, delay)
             (sleep or _sleep)(delay)
@@ -101,7 +125,9 @@ def retry(attempts: int = 4, base_delay: float = 0.1, max_delay: float = 5.0,
           jitter: float = 0.5,
           retry_on: tp.Tuple[tp.Type[BaseException], ...] = (OSError,),
           name: tp.Optional[str] = None, on_exhausted: str = "raise",
-          sleep: tp.Optional[tp.Callable[[float], None]] = None) -> tp.Callable:
+          sleep: tp.Optional[tp.Callable[[float], None]] = None,
+          deadline: tp.Optional[float] = None,
+          clock: tp.Optional[tp.Callable[[], float]] = None) -> tp.Callable:
     """Decorator form of `call_with_retry`::
 
         @retry(retry_on=(OSError,), name="ckpt.write")
@@ -119,7 +145,8 @@ def retry(attempts: int = 4, base_delay: float = 0.1, max_delay: float = 5.0,
                 fn, *args, attempts=attempts, base_delay=base_delay,
                 max_delay=max_delay, jitter=jitter, retry_on=retry_on,
                 name=name or getattr(fn, "__qualname__", repr(fn)),
-                on_exhausted=on_exhausted, sleep=sleep, **kwargs)
+                on_exhausted=on_exhausted, sleep=sleep, deadline=deadline,
+                clock=clock, **kwargs)
 
         return wrapped
 

@@ -9,15 +9,20 @@
 """Serving fleet: router, disaggregated handoff, quotas, deployment."""
 
 from .fleet import (  # noqa
-    ENGINE_FAULT_SITE, FleetMember, ServingFleet,
+    ENGINE_FAULT_SITE, STATUS_FAULT_SITE, FleetMember, ServingFleet,
 )
 from .handoff import DisaggregatedPair, HandoffPacket, hand_off  # noqa
 from .quota import QuotaManager, TenantQuota  # noqa
 from .router import FleetRouter, RouteDecision, fnv1a  # noqa
+from .wal import (  # noqa
+    APPEND_FAULT_SITE, REPLAY_FAULT_SITE, RequestWAL, WALEntry,
+)
 
 __all__ = [
     "ServingFleet", "FleetMember", "ENGINE_FAULT_SITE",
+    "STATUS_FAULT_SITE",
     "DisaggregatedPair", "HandoffPacket", "hand_off",
     "QuotaManager", "TenantQuota",
     "FleetRouter", "RouteDecision", "fnv1a",
+    "RequestWAL", "WALEntry", "APPEND_FAULT_SITE", "REPLAY_FAULT_SITE",
 ]

@@ -20,6 +20,13 @@
 #             (re-prefill from the retained prompt+generated), with
 #             the armed fault verified fired and fleet.json recording
 #             the death
+#   wal       a WAL-backed fleet WORKER PROCESS is SIGKILL'd mid-serve
+#             (queued + decoding requests coexisting, no cleanup of
+#             any kind); a fresh process replays requests.wal and every
+#             acked request is re-served byte-identically vs
+#             generate(), with exactly one completion record per uid
+#             in the raw log (at-least-once, exact dedup) and zero
+#             post-warm-up compiles in the recovering process
 #
 # Everything runs on CPU with a tiny model: the gates are about
 # protocol correctness (block-list handoff, preemption rollback,
@@ -32,7 +39,13 @@ import typing as tp
 
 logger = logging.getLogger(__name__)
 
-LEGS = ("handoff", "sticky", "preempt", "drill")
+LEGS = ("handoff", "sticky", "preempt", "drill", "wal")
+
+# wal leg: tokens per request, and how many fleet steps the worker
+# survives before SIGKILL-ing itself — few enough that requests are
+# still queued AND mid-decode when the process dies.
+WAL_MAX_NEW = 8
+WAL_KILL_STEPS = 3
 
 
 def _fleet_mix(n: int, vocab: int, seed: int, shared: int = 16,
@@ -457,6 +470,201 @@ def run_drill_demo(requests: int = 8, engines: int = 2, slots: int = 4,
     return 1 if failures else 0
 
 
+def _build_wal_fleet(model, params, slots: int, kernel: str, wal_path,
+                     requests: int):
+    """The one fleet configuration the wal leg's worker AND recoverer
+    must share — recovery re-routes deterministically only because the
+    topology (engines, slots, block size) is identical across the kill."""
+    from .fleet import ServingFleet
+    from .quota import QuotaManager, TenantQuota
+    from .wal import RequestWAL
+    return ServingFleet.build(
+        model, params, engines=2, slots=slots, block_size=16,
+        kernel=kernel,
+        quotas=QuotaManager(default=TenantQuota(
+            max_inflight=max(requests, 1))),
+        wal=RequestWAL(wal_path))
+
+
+def _wal_warm_lengths(prompts) -> tp.List[int]:
+    # recovery prefills prompt+generated, so every length up to
+    # len+max_new must land in a warmed bucket
+    return sorted({n for p in prompts
+                   for n in range(len(p), len(p) + WAL_MAX_NEW + 1)})
+
+
+def run_wal_worker(workdir: str, requests: int = 8, slots: int = 2,
+                   seed: int = 0, kernel: str = "gather") -> int:
+    """The condemned half of the wal leg (subprocess target): build the
+    WAL-backed fleet, admit the whole workload, step a few times so
+    queued and mid-decode requests coexist, then SIGKILL this process —
+    no flush, no close, no atexit. Everything the parent recovers must
+    come from what the WAL already made durable."""
+    import os
+    import signal
+    from pathlib import Path
+
+    from ..__main__ import _build_model
+    from .wal import WAL_NAME
+
+    vocab = 64
+    model, params = _build_model(vocab, seed)
+    prompts = _fleet_mix(requests, vocab, seed + 1)
+    fleet = _build_wal_fleet(model, params, slots, kernel,
+                             Path(workdir) / WAL_NAME, requests)
+    fleet.warmup(prompt_lengths=_wal_warm_lengths(prompts))
+    for prompt in prompts:
+        fleet.submit(prompt, WAL_MAX_NEW)
+    for _ in range(WAL_KILL_STEPS):
+        fleet.step()
+    os.kill(os.getpid(), signal.SIGKILL)
+    return 1  # unreachable
+
+
+def run_wal_demo(requests: int = 8, slots: int = 2, seed: int = 0,
+                 kernel: str = "gather",
+                 log: tp.Optional[logging.Logger] = None) -> int:
+    """Gate: a SIGKILL'd fleet process loses nothing it acknowledged.
+
+    A worker subprocess admits `requests` requests into a WAL-backed
+    fleet and is SIGKILL'd after {WAL_KILL_STEPS} steps (some requests
+    still queued, some mid-decode, the WAL possibly torn mid-record).
+    A fresh fleet in THIS process replays the log and must: re-serve
+    every acked uid byte-identically to per-request `generate()`,
+    leave exactly one completion record per uid in the raw jsonl
+    (at-least-once delivery, exact dedup), keep pool conservation, and
+    stay compile-free after its own warm-up. fleet.json is written and
+    re-parsed at the end (crash-consistent status writes).
+    """
+    import json
+    import os
+    import signal
+    import subprocess
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+
+    from ...models.decoding import generate
+    from ...xp import FLEET_STATUS_NAME
+    from ..__main__ import _build_model
+    from .wal import WAL_NAME
+
+    log = log or logger
+    failures = 0
+    vocab = 64
+    with tempfile.TemporaryDirectory() as tmp:
+        workdir = Path(tmp)
+        cmd = [sys.executable, "-m", "flashy_tpu.serve.fleet",
+               "--wal-worker", str(workdir), "-n", str(requests),
+               "-s", str(slots), "--seed", str(seed),
+               "--kernel", kernel]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        log.info("wal leg: spawning a fleet worker to be SIGKILL'd "
+                 "after %d steps (%d requests, %d slots/engine)...",
+                 WAL_KILL_STEPS, requests, slots)
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=600)
+        if proc.returncode != -signal.SIGKILL:
+            log.error("worker should have died by SIGKILL, got rc=%s\n"
+                      "--- worker stderr ---\n%s", proc.returncode,
+                      proc.stderr[-3000:])
+            return 1
+        wal_path = workdir / WAL_NAME
+        if not wal_path.exists():
+            log.error("worker left no WAL at %s", wal_path)
+            return 1
+        log.info("worker dead (SIGKILL confirmed); WAL holds %d bytes",
+                 wal_path.stat().st_size)
+
+        model, params = _build_model(vocab, seed)
+        prompts = _fleet_mix(requests, vocab, seed + 1)
+        fleet = _build_wal_fleet(model, params, slots, kernel, wal_path,
+                                 requests)
+        fleet.warmup(prompt_lengths=_wal_warm_lengths(prompts))
+        warm = {name: dict(member.engine.compile_cache.stats())
+                for name, member in fleet.members.items()}
+        rec = fleet.recover_from_wal()
+        log.info("replayed: %d re-admitted, %d already complete "
+                 "(served from the log)", len(rec["recovered"]),
+                 len(rec["completed"]))
+        fleet.run()
+        fleet.wal.close()
+
+        # the worker acked every submit before stepping, so every uid
+        # 0..requests-1 must be journaled and must re-serve exactly
+        mismatches = 0
+        for uid, prompt in enumerate(prompts):
+            want = np.asarray(generate(model, params, prompt[None],
+                                       max_new_tokens=WAL_MAX_NEW))[0]
+            if uid in rec["completed"]:
+                got = np.concatenate([
+                    prompt, np.asarray(rec["completed"][uid].generated,
+                                       np.int32)])
+            elif uid in rec["recovered"]:
+                handle = rec["recovered"][uid]
+                if not handle.done:
+                    log.error("uid %d still unfinished after recovery",
+                              uid)
+                    mismatches += 1
+                    continue
+                got = np.asarray(handle.output)
+            else:
+                log.error("acked uid %d vanished across the SIGKILL "
+                          "(at-least-once broken)", uid)
+                mismatches += 1
+                continue
+            if not np.array_equal(got, want):
+                mismatches += 1
+                log.error("uid %d not byte-identical after restart:\n"
+                          "  served   %s\n  generate %s", uid,
+                          got.tolist(), want.tolist())
+        if mismatches:
+            failures += 1
+        else:
+            log.info("verified: all %d acked requests re-served "
+                     "byte-identically across the SIGKILL", requests)
+
+        completes: tp.Dict[int, int] = {}
+        with open(wal_path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    record = json.loads(line)
+                except json.JSONDecodeError:
+                    break
+                if record.get("t") == "complete":
+                    uid = record["uid"]
+                    completes[uid] = completes.get(uid, 0) + 1
+        doubles = {u: c for u, c in completes.items() if c != 1}
+        missing = [u for u in range(requests) if u not in completes]
+        if doubles or missing:
+            log.error("dedup/delivery broken in the raw log: "
+                      "doubled=%s missing=%s", doubles, missing)
+            failures += 1
+        else:
+            log.info("raw log: exactly one completion record per uid "
+                     "(at-least-once with exact dedup)")
+
+        for name, member in fleet.members.items():
+            builds, recompiles = _post_warm(member.engine, warm[name])
+            if builds or recompiles:
+                log.error("recovering %s not compile-free post "
+                          "warm-up: %d builds, %d recompiles", name,
+                          builds, recompiles)
+                failures += 1
+            try:
+                member.engine.pool.check()
+            except AssertionError as exc:
+                log.error("%s pool conservation violated after "
+                          "recovery: %s", name, exc)
+                failures += 1
+
+        fleet.write_status(str(workdir))
+        with open(workdir / FLEET_STATUS_NAME) as f:
+            json.load(f)  # must parse: atomic write, never torn
+    return 1 if failures else 0
+
+
 def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m flashy_tpu.serve.fleet",
@@ -475,7 +683,14 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                              "reference is the default here: the fleet "
                              "gates are protocol gates, the fused "
                              "kernel has its own in the paged demo)")
+    parser.add_argument("--wal-worker", metavar="DIR", default=None,
+                        help=argparse.SUPPRESS)  # wal leg's subprocess
     args = parser.parse_args(argv)
+
+    if args.wal_worker:
+        return run_wal_worker(args.wal_worker, requests=args.requests,
+                              slots=args.slots, seed=args.seed,
+                              kernel=args.kernel)
 
     legs = LEGS if args.legs == "all" else tuple(args.legs.split(","))
     unknown = set(legs) - set(LEGS)
@@ -500,6 +715,9 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
         rc |= run_drill_demo(requests=args.requests,
                              engines=args.engines, slots=args.slots,
                              seed=args.seed, kernel=args.kernel)
+    if "wal" in legs:
+        rc |= run_wal_demo(requests=args.requests, slots=2,
+                           seed=args.seed, kernel=args.kernel)
     return rc
 
 

@@ -16,17 +16,22 @@
 import itertools
 import json
 import logging
+import time
 import typing as tp
 from pathlib import Path
 
+import numpy as np
+
 from ...observability.slo import SLOEngine
 from ...resilience import InjectedFault, fault_point
+from ...resilience.retry import call_with_retry
 from ...utils import write_and_rename
 from ...xp import FLEET_STATUS_NAME, AnyPath
 from ..metrics import ServeMetrics
 from ..scheduler import ContinuousBatchingScheduler, QueueFull, Request
 from .quota import QuotaManager
 from .router import FleetRouter
+from .wal import RequestWAL, WALEntry
 
 logger = logging.getLogger(__name__)
 
@@ -34,6 +39,12 @@ logger = logging.getLogger(__name__)
 # arms a strict injector here (ctx carries engine=<name>) to kill a
 # member mid-decode and prove the router re-serves its requests.
 ENGINE_FAULT_SITE = "fleet.engine_step"
+
+# Consulted inside the fleet.json atomic write, between the tmp-file
+# dump and the rename — the kill window the write-and-rename discipline
+# exists for (a fault here must leave the old snapshot intact, never a
+# torn one, and the next write must self-heal).
+STATUS_FAULT_SITE = "fleet.status"
 
 
 class FleetMember:
@@ -76,13 +87,21 @@ class ServingFleet:
         tracing: optional `RequestTracer` shared by every member
             scheduler (uids are fleet-unique, so one journal serves
             all); pass at `build()` time to wire it through.
+        wal: optional `RequestWAL` making admissions durable — submit
+            fsyncs an intent record before acknowledging (and rolls
+            the admission back if the append exhausts its retries),
+            step() journals generated-token high-water marks, _reap
+            fsyncs completion records, and `recover_from_wal()` on a
+            freshly built fleet re-admits everything a killed process
+            left unfinished.
     """
 
     def __init__(self, members: tp.Sequence[FleetMember],
                  router: tp.Optional[FleetRouter] = None,
                  quotas: tp.Optional[QuotaManager] = None,
                  policy: str = "sticky",
-                 tracing: tp.Optional[tp.Any] = None):
+                 tracing: tp.Optional[tp.Any] = None,
+                 wal: tp.Optional[RequestWAL] = None):
         members = list(members)
         if not members:
             raise ValueError("a fleet needs at least one member")
@@ -103,6 +122,7 @@ class ServingFleet:
         self.router = router
         self.quotas = quotas or QuotaManager()
         self.tracing = tracing
+        self.wal = wal
         # uid -> (request, tenant, member name); reaped as they finish
         self._inflight: tp.Dict[int, tp.List[tp.Any]] = {}
         self._route_seq = 0  # round-robin clock (== submit attempts)
@@ -123,6 +143,7 @@ class ServingFleet:
               slo_kwargs: tp.Optional[tp.Dict[str, tp.Any]] = None,
               tracing: tp.Optional[tp.Any] = None,
               names: tp.Optional[tp.Sequence[str]] = None,
+              wal: tp.Optional[RequestWAL] = None,
               **engine_kwargs: tp.Any) -> "ServingFleet":
         """Stand up a homogeneous fleet: `engines` paged DecodeEngines
         (each `cache_scope`d by its name — mandatory for co-resident
@@ -153,7 +174,8 @@ class ServingFleet:
                 engine, max_queue=max_queue, metrics=metrics,
                 tracing=tracing, uid_source=uid_source)
             members.append(FleetMember(name, scheduler, slo=slos[name]))
-        return cls(members, quotas=quotas, policy=policy, tracing=tracing)
+        return cls(members, quotas=quotas, policy=policy, tracing=tracing,
+                   wal=wal)
 
     def warmup(self, prompt_lengths: tp.Iterable[int] = ()) -> None:
         """Pre-compile every member's executables (distinct cache
@@ -208,6 +230,20 @@ class ServingFleet:
         except (QueueFull, ValueError):
             self.quotas.release(tenant)
             raise
+        if self.wal is not None:
+            # accept implies durable: the intent record must be fsync'd
+            # before submit() returns. The deadline-capped retry absorbs
+            # transient IO faults; on exhaustion the admission rolls
+            # back (queue + quota) — a request we never acked is allowed
+            # to be lost, an acked one is not.
+            try:
+                call_with_retry(self.wal.append_admit, request,
+                                name="fleet.wal_append", retry_on=(OSError,),
+                                attempts=3, base_delay=0.01, deadline=5.0)
+            except BaseException:
+                member.scheduler.cancel_queued(request.uid)
+                self.quotas.release(tenant)
+                raise
         self.route_reasons[decision.reason] = \
             self.route_reasons.get(decision.reason, 0) + 1
         self.engine_routed[decision.engine] += 1
@@ -250,10 +286,17 @@ class ServingFleet:
         return len(drained)
 
     def _reap(self) -> None:
-        """Return quota credits for requests that finished this step."""
+        """Return quota credits for requests that finished this step
+        (journaling each one's completion record first — retirement is
+        not durable until the WAL says so)."""
         for uid in [u for u, (r, _, _) in self._inflight.items()
                     if r.done]:
-            _, tenant, _ = self._inflight.pop(uid)
+            request, tenant, _ = self._inflight.pop(uid)
+            if self.wal is not None:
+                call_with_retry(
+                    self.wal.append_complete, request,
+                    name="fleet.wal_append", retry_on=(OSError,),
+                    attempts=3, base_delay=0.01, deadline=5.0)
             self.quotas.release(tenant)
 
     def step(self) -> int:
@@ -277,6 +320,16 @@ class ServingFleet:
                 self.kill(name)
                 continue
             emitted += member.scheduler.step()
+        if self.wal is not None:
+            # high-water marks are best-effort (on_exhausted='warn'):
+            # losing one costs re-served tokens after a crash, never
+            # correctness — the re-served suffix is deterministic.
+            call_with_retry(
+                self.wal.note_progress,
+                [r for r, _, _ in self._inflight.values()],
+                name="fleet.wal_append", retry_on=(OSError,),
+                attempts=3, base_delay=0.01, deadline=5.0,
+                on_exhausted="warn")
         self._reap()
         return emitted
 
@@ -290,6 +343,93 @@ class ServingFleet:
                 return
             self.step()
         raise RuntimeError(f"fleet did not drain in {max_steps} steps")
+
+    # ------------------------------------------------------------------
+    # crash recovery
+    # ------------------------------------------------------------------
+    def recover_from_wal(self) -> tp.Dict[str, tp.Any]:
+        """Replay the attached WAL into this freshly built fleet.
+
+        Every logged-but-incomplete request is rebuilt with its
+        ORIGINAL uid and its generated-token high-water mark, then
+        re-admitted through the ordinary machinery: deterministic
+        route, `enqueue` (no depth cap — it was already admitted once),
+        quota re-acquired, `_inflight` registered. Admission prefills
+        `resume_prompt` (prompt + replayed tokens), which re-derives
+        the lost K/V exactly, and greedy decode is deterministic — so
+        the re-served suffix, appended to the replayed prefix, is
+        byte-identical to an uninterrupted run. Requests whose replayed
+        tokens already terminate (EOS logged, or budget exhausted)
+        crashed between their final step and the completion append;
+        they are completed synthetically from the log, NOT re-served —
+        the exact-dedup half of at-least-once delivery.
+
+        Returns ``{"recovered": {uid: Request}, "completed":
+        {uid: WALEntry}}`` — `completed` holds finished streams a
+        restarted front-end answers from without recomputing a token.
+        """
+        if self.wal is None:
+            raise ValueError("recover_from_wal() needs a fleet built "
+                             "with a RequestWAL attached")
+        if self._inflight:
+            raise RuntimeError("recover_from_wal() must run on a fresh "
+                               "fleet, before any submits")
+        entries = self.wal.replay()
+        recovered: tp.Dict[int, Request] = {}
+        completed: tp.Dict[int, WALEntry] = {}
+        if not entries:
+            return {"recovered": recovered, "completed": completed}
+        # the member schedulers share one uid counter; advancing any one
+        # of them advances the fleet
+        next(iter(self.members.values())).scheduler.advance_uids(
+            max(entries))
+        for uid in sorted(entries):
+            entry = entries[uid]
+            if entry.complete:
+                completed[uid] = entry
+                continue
+            request = Request(
+                uid=uid, prompt=np.asarray(entry.prompt, np.int32),
+                max_new_tokens=entry.max_new_tokens,
+                eos_token=entry.eos_token, tenant=entry.tenant,
+                priority=entry.priority, submitted_at=time.perf_counter())
+            request.generated = list(entry.generated)
+            reason = None
+            if (entry.eos_token is not None
+                    and entry.eos_token in request.generated):
+                reason = "eos"
+            elif request.remaining_budget <= 0:
+                reason = "length"
+            if reason is not None:
+                # finished before the kill, just never journaled done
+                request.state = "done"
+                request.finish_reason = reason
+                self.wal.append_complete(request)
+                entry.generated = list(request.generated)
+                entry.complete, entry.finish_reason = True, reason
+                entry.complete_records += 1
+                completed[uid] = entry
+                continue
+            if not self.quotas.try_acquire(entry.tenant):
+                raise RuntimeError(
+                    f"WAL recovery: tenant {entry.tenant!r} no longer "
+                    f"fits its quota — the restarted fleet must be "
+                    f"built with at least the quotas the WAL was "
+                    f"written under")
+            decision = self.router.route(self._route_seq, request.prompt,
+                                         healthy=self.healthy,
+                                         alerting=self.alerting())
+            self._route_seq += 1
+            self.members[decision.engine].scheduler.enqueue(request)
+            self.route_reasons[decision.reason] = \
+                self.route_reasons.get(decision.reason, 0) + 1
+            self.engine_routed[decision.engine] += 1
+            self._inflight[uid] = [request, entry.tenant, decision.engine]
+            recovered[uid] = request
+        logger.info("WAL recovery: re-admitted %d incomplete request(s), "
+                    "%d already complete (served from the log)",
+                    len(recovered), len(completed))
+        return {"recovered": recovered, "completed": completed}
 
     # ------------------------------------------------------------------
     # status
@@ -339,4 +479,10 @@ class ServingFleet:
         target.parent.mkdir(parents=True, exist_ok=True)
         with write_and_rename(target, "w") as f:
             json.dump(self.status(), f, indent=2, default=float)
+            # the kill window: tmp fully written, rename not yet done.
+            # A fault here must leave the previous snapshot (or no
+            # file) in place — a reader can never observe a torn
+            # fleet.json, and the next write truncates the tmp file
+            # and self-heals.
+            fault_point(STATUS_FAULT_SITE, file=FLEET_STATUS_NAME)
         return target

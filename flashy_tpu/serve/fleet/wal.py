@@ -1,0 +1,247 @@
+# The durable half of the fleet front door. Engine death (PR 17) is
+# survivable because the PROCESS survives to drain and re-route; a kill
+# of the fleet process itself loses every queued and in-flight request
+# with no trace that they were ever accepted. The write-ahead log here
+# is the serving twin of the training checkpoint discipline: an intent
+# record is fsync'd BEFORE submit() acknowledges (accept implies
+# durable), generated-token high-water marks land on a step cadence,
+# and a completion record is fsync'd at retirement — so a restarted
+# fleet replays the log, re-admits every incomplete request through the
+# ordinary `resume_prompt` machinery (prefilling prompt+generated
+# re-derives the lost K/V exactly; greedy decode is deterministic, so
+# the re-served suffix is byte-identical to the uninterrupted run), and
+# answers completed requests from the log without recomputing a token.
+# Delivery is at-least-once with exact dedup by uid: a request may be
+# re-served past its logged high-water mark, but its uid never yields
+# two completion records.
+"""RequestWAL: durable request journal for crash-consistent fleets."""
+import dataclasses
+import json
+import logging
+import os
+import typing as tp
+from pathlib import Path
+
+import numpy as np
+
+from ...resilience import fault_point
+from ...utils import AnyPath
+
+logger = logging.getLogger(__name__)
+
+# Default WAL filename inside an xp folder, next to fleet.json.
+WAL_NAME = "requests.wal"
+
+# Consulted on every record append (ctx: kind=admit|progress|complete,
+# uid) and once per replay. A transient fault at the append site is
+# absorbed by the fleet door's deadline-capped retry; exhaustion there
+# rolls the admission back (never-acked requests are allowed to fail).
+APPEND_FAULT_SITE = "fleet.wal_append"
+REPLAY_FAULT_SITE = "fleet.wal_replay"
+
+
+@dataclasses.dataclass
+class WALEntry:
+    """One request's replayed state: the merge of its WAL records."""
+    uid: int
+    prompt: tp.List[int]
+    max_new_tokens: int
+    eos_token: tp.Optional[int]
+    tenant: str
+    priority: int
+    generated: tp.List[int] = dataclasses.field(default_factory=list)
+    complete: bool = False
+    finish_reason: tp.Optional[str] = None
+    complete_records: int = 0  # dedup evidence: must end at exactly 1
+
+
+class RequestWAL:
+    """Append-only jsonl journal of request intents and outcomes.
+
+    Record kinds (one JSON object per line):
+      ``admit``     uid + everything needed to rebuild the Request
+                    (prompt, max_new, eos, tenant, priority); fsync'd
+                    before the fleet door acknowledges the submit.
+      ``progress``  uid + ``n`` (total generated after this record) +
+                    ``tokens`` (the delta since the last logged mark);
+                    appended every `progress_every` fleet steps.
+      ``complete``  uid + finish_reason + the FULL generated stream;
+                    fsync'd at retirement. Restart serves completed
+                    requests straight from this record — no recompute.
+
+    A SIGKILL can tear at most the final line (appends are sequential);
+    `replay()` stops at the first undecodable line and warns, so a torn
+    tail costs at worst the most recent unsynced progress mark — which
+    re-serving regenerates token-identically anyway (greedy decode of
+    the same prompt is deterministic).
+    """
+
+    def __init__(self, path: AnyPath, progress_every: int = 1):
+        if progress_every < 1:
+            raise ValueError(f"progress_every must be >= 1, "
+                             f"got {progress_every}")
+        self.path = Path(path)
+        self.progress_every = progress_every
+        self._f: tp.Optional[tp.TextIO] = None
+        self._steps = 0                       # note_progress call count
+        self._marks: tp.Dict[int, int] = {}   # uid -> logged token count
+        self._completed: tp.Set[int] = set()  # uids with a complete record
+
+    # ------------------------------------------------------------------
+    # appending
+    # ------------------------------------------------------------------
+    def _append(self, record: tp.Dict[str, tp.Any], fsync: bool) -> None:
+        fault_point(APPEND_FAULT_SITE, kind=record["t"], uid=record["uid"])
+        if self._f is None:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._f = open(self.path, "a", encoding="utf-8")
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+        if fsync:
+            os.fsync(self._f.fileno())
+
+    def append_admit(self, request: tp.Any) -> None:
+        """Journal the intent record; MUST be durable (fsync) before
+        the caller acknowledges the request as accepted. Raises OSError
+        on failure — the fleet door retries, then rolls the admission
+        back (an un-acked request may be lost; an acked one may not)."""
+        self._append({
+            "t": "admit",
+            "uid": int(request.uid),
+            "prompt": np.asarray(request.prompt).astype(int).tolist(),
+            "max_new": int(request.max_new_tokens),
+            "eos": (int(request.eos_token)
+                    if request.eos_token is not None else None),
+            "tenant": request.tenant,
+            "priority": int(request.priority),
+        }, fsync=True)
+        self._marks.setdefault(int(request.uid), 0)
+
+    def note_progress(self, requests: tp.Iterable[tp.Any]) -> int:
+        """Called once per fleet step: every `progress_every` calls,
+        append a high-water mark for each request that generated new
+        tokens since its last mark. Returns records written. One fsync
+        covers the whole batch — the cadence bounds how many re-served
+        tokens a crash can cost, not whether output is correct (the
+        re-served suffix is deterministic either way)."""
+        self._steps += 1
+        if self._steps % self.progress_every:
+            return 0
+        written = 0
+        for request in requests:
+            uid = int(request.uid)
+            if uid in self._completed:
+                continue
+            mark = self._marks.get(uid, 0)
+            total = len(request.generated)
+            if total <= mark:
+                continue
+            self._append({"t": "progress", "uid": uid, "n": total,
+                          "tokens": [int(t) for t
+                                     in request.generated[mark:]]},
+                         fsync=False)
+            self._marks[uid] = total
+            written += 1
+        if written and self._f is not None:
+            os.fsync(self._f.fileno())
+        return written
+
+    def append_complete(self, request: tp.Any) -> None:
+        """Journal the outcome record (fsync'd): full generated stream
+        + finish reason. Idempotent per uid within this process — the
+        dedup oracle asserts the LOG holds exactly one per uid."""
+        uid = int(request.uid)
+        if uid in self._completed:
+            return
+        self._append({"t": "complete", "uid": uid,
+                      "reason": request.finish_reason,
+                      "tokens": [int(t) for t in request.generated]},
+                     fsync=True)
+        self._completed.add(uid)
+        self._marks[uid] = len(request.generated)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    # ------------------------------------------------------------------
+    # replay
+    # ------------------------------------------------------------------
+    def replay(self) -> tp.Dict[int, WALEntry]:
+        """Fold the journal into per-uid entries (admission order).
+
+        Tolerates a torn tail: the first undecodable line stops the
+        scan with a warning AND truncates the file back to the last
+        good record (a sequential-append crash can only tear the end;
+        without the truncate, a recovered fleet would append after the
+        garbage and strand its own records behind an undecodable line).
+        Progress records are merged defensively by their total count
+        `n`, so duplicates or stale marks can never shrink or corrupt
+        a stream. Also primes this WAL's in-memory marks, so a
+        recovered fleet appending to the SAME file continues from the
+        replayed high-water marks instead of re-logging the prefix.
+        """
+        fault_point(REPLAY_FAULT_SITE, path=str(self.path))
+        entries: tp.Dict[int, WALEntry] = {}
+        if not self.path.exists():
+            return entries
+        torn_at: tp.Optional[int] = None
+        with open(self.path, "r", encoding="utf-8") as f:
+            lineno = 0
+            while True:
+                offset = f.tell()
+                line = f.readline()
+                if not line:
+                    break
+                lineno += 1
+                try:
+                    record = json.loads(line)
+                except json.JSONDecodeError:
+                    torn_at = offset
+                    logger.warning(
+                        "WAL %s: undecodable line %d (torn tail after a "
+                        "crash); truncating back to byte %d",
+                        self.path, lineno, offset)
+                    break
+                kind = record.get("t")
+                uid = record.get("uid")
+                if kind == "admit":
+                    if uid in entries:
+                        logger.warning("WAL %s: duplicate admit for uid "
+                                       "%s (line %d); keeping the first",
+                                       self.path, uid, lineno)
+                        continue
+                    entries[uid] = WALEntry(
+                        uid=uid, prompt=list(record["prompt"]),
+                        max_new_tokens=record["max_new"],
+                        eos_token=record["eos"], tenant=record["tenant"],
+                        priority=record["priority"])
+                    continue
+                entry = entries.get(uid)
+                if entry is None:
+                    logger.warning("WAL %s: %s record for unknown uid %s "
+                                   "(line %d); skipping",
+                                   self.path, kind, uid, lineno)
+                    continue
+                if kind == "progress":
+                    total = record["n"]
+                    have = len(entry.generated)
+                    if total > have:
+                        entry.generated.extend(
+                            record["tokens"][-(total - have):])
+                elif kind == "complete":
+                    entry.complete_records += 1
+                    entry.complete = True
+                    entry.finish_reason = record["reason"]
+                    entry.generated = list(record["tokens"])
+        if torn_at is not None:
+            # must happen before any post-recovery append lands
+            assert self._f is None, "replay() must precede appends"
+            with open(self.path, "r+", encoding="utf-8") as f:
+                f.truncate(torn_at)
+        for uid, entry in entries.items():
+            self._marks[uid] = len(entry.generated)
+            if entry.complete:
+                self._completed.add(uid)
+        return entries

@@ -290,4 +290,10 @@ class ServeMetrics:
         target.parent.mkdir(parents=True, exist_ok=True)
         with write_and_rename(target, "w") as f:
             json.dump(payload, f, indent=2, default=float)
+            # kill window between tmp-write and rename (same site as
+            # fleet.json — one atomic-status discipline, one fault):
+            # a fault here must leave the old serve.json (or none),
+            # never a torn one, and the next write self-heals.
+            from ..resilience import fault_point
+            fault_point("fleet.status", file=SERVE_STATUS_NAME)
         return target

@@ -393,6 +393,37 @@ class ContinuousBatchingScheduler:
         else:
             self._queue.append(request)
 
+    def cancel_queued(self, uid: int) -> Request:
+        """Remove a still-queued request by uid; returns it.
+
+        The admission-rollback path: the fleet door accepts a request
+        into a member queue FIRST and only then journals it to the
+        durable WAL — if that append exhausts its retries, the request
+        was never acknowledged durable and must leave the queue (and
+        return its quota credit) rather than run un-logged. Only legal
+        while the request is still 'queued'; once prefill starts the
+        WAL record already exists, so there is nothing to roll back.
+        """
+        for i, request in enumerate(self._queue):
+            if request.uid == uid:
+                del self._queue[i]
+                return request
+        raise ValueError(f"request {uid} is not in the admission queue "
+                         f"(already admitted, finished, or never here)")
+
+    def advance_uids(self, beyond: int) -> None:
+        """Fast-forward the uid source past `beyond` (inclusive).
+
+        WAL recovery re-admits requests with their ORIGINAL uids (dedup
+        keys on them), so the shared counter of a freshly built fleet
+        must skip everything the WAL already issued — otherwise the
+        first new submit would collide with a replayed uid. Draws and
+        discards values; a gap in the uid sequence is fine (uniqueness,
+        not density, is the contract).
+        """
+        while next(self._uid) < beyond:
+            pass
+
     def drain_for_reroute(self) -> tp.List[Request]:
         """Pull EVERY unfinished request out of this scheduler without
         touching the engine — the engine is presumed dead, so no

@@ -183,9 +183,10 @@ def test_compact_line_fits_driver_tail_worst_case():
         "bubble_frac_1f1b_int2": 0.157895, "stash_flat_in_m": True,
         "recompiles": 0, "packed_step_ratio": 0.5717,
         "packed_tick_eff": 0.8984, "packed_bitwise": True,
-        # the decode sub-leg scalars (spec/paged/fused) are deliberately
-        # NOT in this maximal leg: they only ever appear in the one
-        # decode entry (never once per leg), and the runtime shed guard
+        # the decode sub-leg scalars (spec/paged/fused) and the
+        # recovery scalars (wal_replay_ms & co) are deliberately NOT
+        # in this maximal leg: they only ever appear in their one
+        # entry (never once per leg), and the runtime shed guard
         # keeps any real overflow inside MAX_LINE_CHARS by trimming
         # detail — the convention since the spec/paged sublegs landed.
         "fused_vs_gather": 12.345,

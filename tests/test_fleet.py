@@ -369,3 +369,275 @@ def test_fleet_demo_entrypoint_smoke(caplog):
 
     with caplog.at_level("INFO"):
         assert main(["-n", "4", "--legs", "handoff"]) == 0
+
+# ----------------------------------------------------------------------
+# the request WAL: durable admission, replay, torn tails, dedup
+# ----------------------------------------------------------------------
+def _wal_request(uid, prompt=(1, 2, 3), max_new=5, generated=(),
+                 reason=None, tenant="default", priority=0):
+    """A Request stand-in with exactly the fields the WAL reads."""
+    import types
+    return types.SimpleNamespace(
+        uid=uid, prompt=np.asarray(prompt, np.int32),
+        max_new_tokens=max_new, eos_token=None, tenant=tenant,
+        priority=priority, generated=list(generated),
+        finish_reason=reason)
+
+
+def test_wal_roundtrip_admit_progress_complete(tmp_path):
+    from flashy_tpu.serve.fleet.wal import RequestWAL
+
+    wal = RequestWAL(tmp_path / "requests.wal")
+    a = _wal_request(0, prompt=(5, 6), generated=[])
+    b = _wal_request(1, prompt=(7,), generated=[])
+    wal.append_admit(a)
+    wal.append_admit(b)
+    a.generated = [10, 11]
+    assert wal.note_progress([a, b]) == 1  # b generated nothing yet
+    a.generated = [10, 11, 12]
+    b.generated = [20]
+    wal.note_progress([a, b])
+    a.finish_reason = "length"
+    wal.append_complete(a)
+    wal.close()
+
+    entries = RequestWAL(tmp_path / "requests.wal").replay()
+    assert sorted(entries) == [0, 1]
+    assert entries[0].complete and entries[0].finish_reason == "length"
+    assert entries[0].generated == [10, 11, 12]
+    assert entries[0].complete_records == 1
+    assert not entries[1].complete and entries[1].generated == [20]
+    assert entries[1].prompt == [7]
+
+
+def test_wal_torn_tail_truncates_and_self_heals(tmp_path):
+    from flashy_tpu.serve.fleet.wal import RequestWAL
+
+    path = tmp_path / "requests.wal"
+    wal = RequestWAL(path)
+    req = _wal_request(0, generated=[1, 2])
+    wal.append_admit(req)
+    wal.note_progress([req])
+    wal.close()
+    good_size = path.stat().st_size
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"t": "progress", "uid": 0, "n"')  # SIGKILL mid-write
+
+    wal2 = RequestWAL(path)
+    entries = wal2.replay()
+    assert entries[0].generated == [1, 2]  # torn record never merged
+    assert path.stat().st_size == good_size  # file truncated back
+    # a post-recovery append lands where the garbage was, so a THIRD
+    # replay sees the full history — nothing stranded behind the tear
+    req.generated = [1, 2, 3]
+    req.finish_reason = "length"
+    wal2.append_complete(req)
+    wal2.close()
+    final = RequestWAL(path).replay()
+    assert final[0].complete and final[0].generated == [1, 2, 3]
+    assert final[0].complete_records == 1
+
+
+def test_wal_replay_merges_progress_defensively(tmp_path):
+    from flashy_tpu.serve.fleet.wal import RequestWAL
+
+    path = tmp_path / "requests.wal"
+    records = [
+        {"t": "admit", "uid": 0, "prompt": [1], "max_new": 9,
+         "eos": None, "tenant": "default", "priority": 0},
+        {"t": "progress", "uid": 0, "n": 2, "tokens": [4, 5]},
+        {"t": "progress", "uid": 0, "n": 2, "tokens": [4, 5]},  # dup
+        {"t": "progress", "uid": 0, "n": 1, "tokens": [4]},  # stale
+        {"t": "progress", "uid": 0, "n": 3, "tokens": [6]},  # delta
+        {"t": "progress", "uid": 7, "n": 1, "tokens": [9]},  # unknown
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        for record in records:
+            f.write(json.dumps(record) + "\n")
+    entries = RequestWAL(path).replay()
+    assert list(entries) == [0]
+    assert entries[0].generated == [4, 5, 6]
+
+
+def test_wal_replay_primes_marks_against_relogging(tmp_path):
+    from flashy_tpu.serve.fleet.wal import RequestWAL
+
+    path = tmp_path / "requests.wal"
+    wal = RequestWAL(path)
+    req = _wal_request(0, generated=[1, 2])
+    wal.append_admit(req)
+    wal.note_progress([req])
+    wal.close()
+
+    wal2 = RequestWAL(path)
+    wal2.replay()
+    # same high-water mark: a recovered fleet's first step must not
+    # re-log the prefix it just replayed
+    assert wal2.note_progress([req]) == 0
+    req.generated = [1, 2, 3]
+    assert wal2.note_progress([req]) == 1  # only the new token
+    wal2.close()
+    entries = RequestWAL(path).replay()
+    assert entries[0].generated == [1, 2, 3]
+
+
+def test_wal_complete_is_idempotent_in_process(tmp_path):
+    from flashy_tpu.serve.fleet.wal import RequestWAL
+
+    path = tmp_path / "requests.wal"
+    wal = RequestWAL(path)
+    req = _wal_request(0, generated=[1], reason="length")
+    wal.append_admit(req)
+    wal.append_complete(req)
+    wal.append_complete(req)  # second retirement: no second record
+    wal.close()
+    raw = [json.loads(line) for line in path.read_text().splitlines()]
+    assert sum(r["t"] == "complete" for r in raw) == 1
+    assert RequestWAL(path).replay()[0].complete_records == 1
+
+
+def test_wal_rejects_bad_progress_cadence(tmp_path):
+    from flashy_tpu.serve.fleet.wal import RequestWAL
+
+    with pytest.raises(ValueError, match="progress_every"):
+        RequestWAL(tmp_path / "requests.wal", progress_every=0)
+
+
+@pytest.mark.slow
+def test_fleet_wal_crash_recovery_token_exact(tmp_path):
+    from flashy_tpu.models.decoding import generate
+    from flashy_tpu.serve.fleet.wal import RequestWAL
+
+    model, params = _fleet_model()
+    wal_path = tmp_path / "requests.wal"
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 32, 3 + i % 4).astype(np.int32)
+               for i in range(5)]
+    max_new = 6
+    lengths = sorted({n for p in prompts
+                      for n in range(len(p), len(p) + max_new + 1)})
+
+    def build():
+        return ServingFleet.build(
+            model, params, engines=2, slots=2, block_size=4,
+            kernel="gather",
+            quotas=QuotaManager(default=TenantQuota(max_inflight=32)),
+            wal=RequestWAL(wal_path))
+
+    fleet = build()
+    fleet.warmup(prompt_lengths=lengths)
+    handles = [fleet.submit(p, max_new) for p in prompts]
+    for _ in range(2):
+        fleet.step()  # some mid-decode, some queued — then "crash"
+    fleet.wal.close()
+    del fleet
+
+    fleet2 = build()
+    fleet2.warmup(prompt_lengths=lengths)
+    rec = fleet2.recover_from_wal()
+    assert set(rec["recovered"]) | set(rec["completed"]) \
+        == {h.uid for h in handles}
+    fleet2.run()
+    for prompt, handle in zip(prompts, handles):
+        want = np.asarray(generate(model, params, prompt[None],
+                                   max_new_tokens=max_new))[0]
+        uid = handle.uid
+        if uid in rec["completed"]:
+            got = np.concatenate([
+                prompt,
+                np.asarray(rec["completed"][uid].generated, np.int32)])
+        else:
+            recovered = rec["recovered"][uid]
+            assert recovered.done
+            got = np.asarray(recovered.output)
+        np.testing.assert_array_equal(got, want)
+    # a new submit must not collide with journaled uids
+    probe = fleet2.submit(prompts[0], max_new)
+    assert probe.uid > max(h.uid for h in handles)
+    fleet2.run()
+    fleet2.wal.close()
+    # at-least-once with exact dedup: one completion record per uid
+    completes = {}
+    for line in wal_path.read_text().splitlines():
+        record = json.loads(line)
+        if record["t"] == "complete":
+            completes[record["uid"]] = completes.get(record["uid"], 0) + 1
+    assert set(completes) == {h.uid for h in handles} | {probe.uid}
+    assert all(c == 1 for c in completes.values())
+    for member in fleet2.members.values():
+        member.engine.pool.check()
+
+
+# ----------------------------------------------------------------------
+# crash-consistent status snapshots (fleet.json / serve.json)
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_fleet_status_never_torn_under_injected_crash(tmp_path):
+    from flashy_tpu.resilience import chaos
+    from flashy_tpu.serve.fleet.fleet import STATUS_FAULT_SITE
+    from flashy_tpu.xp import FLEET_STATUS_NAME
+
+    model, params = _fleet_model()
+    fleet = ServingFleet.build(
+        model, params, engines=1, slots=2, block_size=4, kernel="gather",
+        quotas=QuotaManager(default=TenantQuota(max_inflight=4)))
+    fleet.warmup(prompt_lengths=[4])
+    fleet.submit(np.arange(4, dtype=np.int32), 2)
+    fleet.run()
+    target = tmp_path / FLEET_STATUS_NAME
+
+    fleet.write_status(tmp_path)
+    with open(target) as f:
+        first = json.load(f)  # a valid snapshot exists
+
+    injector = chaos.install(strict=True)
+    injector.fail_at(STATUS_FAULT_SITE, call=1)
+    try:
+        # crash in the kill window: tmp written, rename not yet done
+        with pytest.raises(chaos.InjectedFault):
+            fleet.write_status(tmp_path)
+    finally:
+        chaos.uninstall()
+    with open(target) as f:
+        assert json.load(f) == first  # previous snapshot intact, not torn
+
+    fleet.submit(np.arange(4, dtype=np.int32), 2)
+    fleet.run()
+    fleet.write_status(tmp_path)  # next write truncates tmp: self-heals
+    with open(target) as f:
+        healed = json.load(f)
+    assert healed["engines"] != {} and healed != first
+
+
+@pytest.mark.slow
+def test_serve_status_never_torn_under_injected_crash(tmp_path):
+    from flashy_tpu.resilience import chaos
+    from flashy_tpu.xp import SERVE_STATUS_NAME
+
+    model, params = _fleet_model()
+    fleet = ServingFleet.build(
+        model, params, engines=1, slots=2, block_size=4, kernel="gather",
+        quotas=QuotaManager(default=TenantQuota(max_inflight=4)))
+    fleet.warmup(prompt_lengths=[4])
+    fleet.submit(np.arange(4, dtype=np.int32), 2)
+    fleet.run()
+    metrics = next(iter(fleet.members.values())).scheduler.metrics
+    target = tmp_path / SERVE_STATUS_NAME
+
+    metrics.write_status(tmp_path)
+    with open(target) as f:
+        first = json.load(f)
+
+    injector = chaos.install(strict=True)
+    injector.fail_at("fleet.status", call=1)
+    try:
+        with pytest.raises(chaos.InjectedFault):
+            metrics.write_status(tmp_path)
+    finally:
+        chaos.uninstall()
+    with open(target) as f:
+        assert json.load(f) == first
+
+    metrics.write_status(tmp_path)
+    with open(target) as f:
+        json.load(f)  # self-healed: parses again

@@ -85,3 +85,22 @@ def test_verify_checkpoint_cli_prints_topology(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "topology: saved on 8 device(s)" in out
     assert "zero1(data=8)" in out
+
+
+def test_faults_report_lists_every_registry_site(capsys):
+    from flashy_tpu.analysis.registry import FAULT_SITES
+
+    assert main(["--faults"]) == 0
+    out = capsys.readouterr().out
+    for site in FAULT_SITES:
+        assert site in out, site
+    assert "covered by" in out
+    assert "fleet.wal_append" in out
+    assert "logger.*" in out  # prefix row rendered for the family
+
+
+def test_faults_report_strict_passes_when_coverage_complete(capsys):
+    # strict mode only fails on UNCOVERED / unregistered rows; the
+    # shipped campaign covers the whole registry, so this gate holds
+    assert main(["--faults", "--strict"]) == 0
+    assert "UNCOVERED" not in capsys.readouterr().out

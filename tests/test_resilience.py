@@ -101,6 +101,75 @@ def test_backoff_exponential_growth_and_cap():
     assert 0.1 <= jittered <= 0.15
 
 
+def test_retry_deadline_caps_total_wallclock():
+    now = {"t": 0.0}
+
+    def sleep(delay):
+        now["t"] += delay
+
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        call_with_retry(broken, attempts=100, base_delay=0.4,
+                        max_delay=10.0, jitter=0.0, deadline=1.0,
+                        sleep=sleep, clock=lambda: now["t"])
+    # attempt 1 fails (delay 0.4 fits the budget), attempt 2 fails and
+    # the NEXT backoff (0.8) would blow the 1.0s deadline -> exhausted
+    # after 2 calls, nowhere near the 100-attempt cap
+    assert calls["n"] == 2
+
+
+def test_retry_deadline_exhaustion_honors_warn(caplog):
+    now = {"t": 0.0}
+
+    def broken():
+        raise OSError("down")
+
+    with caplog.at_level(logging.WARNING, "flashy_tpu.resilience.retry"):
+        out = call_with_retry(
+            broken, attempts=100, base_delay=0.4, jitter=0.0,
+            deadline=0.5, on_exhausted="warn",
+            sleep=lambda d: now.__setitem__("t", now["t"] + d),
+            clock=lambda: now["t"])
+    assert out is None
+    assert any("deadline" in r.message for r in caplog.records)
+
+
+def test_retry_rejects_nonpositive_deadline():
+    with pytest.raises(ValueError, match="deadline"):
+        call_with_retry(lambda: None, deadline=0.0)
+
+
+def test_delay_at_stalls_without_raising(injector, monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(chaos, "_sleep", sleeps.append)
+    injector.delay_at("drill.step", call=2, seconds=0.25)
+    for _ in range(3):
+        chaos.fault_point("drill.step")  # never raises
+    assert sleeps == [0.25]  # fired exactly at occurrence 2
+    assert injector.hits("drill.step", "delay") == 1
+    assert not injector.unfired_rules()
+
+
+def test_delay_at_times_spans_consecutive_occurrences(injector,
+                                                      monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(chaos, "_sleep", sleeps.append)
+    injector.delay_at("drill.step", call=2, seconds=0.1, times=2)
+    for _ in range(4):
+        chaos.fault_point("drill.step")
+    assert sleeps == [0.1, 0.1]  # occurrences 2 and 3
+
+
+def test_delay_at_rejects_negative_seconds(injector):
+    with pytest.raises(ValueError, match="seconds"):
+        injector.delay_at("drill.step", call=1, seconds=-1.0)
+
+
 def test_retry_attempts_journaled_through_tracer(tmp_path):
     from flashy_tpu import observability
     telemetry = observability.enable_telemetry(folder=tmp_path,
