@@ -181,6 +181,18 @@ pipeline-demo:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -W "ignore::RuntimeWarning:runpy" -m flashy_tpu.parallel.pipeline --steps 3
 
+# Tensor-parallel (megatron) demo on 8 virtual CPU devices: train-step
+# time, achieved TFLOP/s and per-chip optimizer HBM at tensor widths
+# {1,2,4} with the zero1 update shard composed on top. Exit 1 unless
+# TP gradients match the replicated single-chip oracle, per-chip
+# optimizer bytes land at ~1/(data*tensor), the fused flash backward
+# is BIT-identical to the split two-kernel oracle (interpret mode),
+# and zero post-warm-up recompiles were reported. A couple of minutes;
+# also run by the tests workflow.
+tp-demo:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m flashy_tpu.parallel.tensor --steps 3
+
 # Elastic world-size drill on 8 virtual CPU devices: train at world 8,
 # take a simulated SIGTERM mid-epoch, resume at world 4 (a lost slice)
 # and grow back to 8 — with transient faults injected into the
@@ -219,4 +231,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo serve-slo-demo fleet-demo chaos-demo chaos-campaign elastic-demo zero-demo pipeline-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo serve-slo-demo fleet-demo chaos-demo chaos-campaign elastic-demo zero-demo pipeline-demo tp-demo datapipe-demo docs native dist

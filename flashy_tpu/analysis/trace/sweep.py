@@ -14,7 +14,7 @@ from .core import AuditProgram
 
 __all__ = ["demo_programs", "SWEEP_LEGS"]
 
-SWEEP_LEGS = ("zero", "pipeline", "serve", "elastic")
+SWEEP_LEGS = ("zero", "pipeline", "serve", "elastic", "tensor")
 
 
 def _require_devices(minimum: int) -> None:
@@ -276,11 +276,81 @@ def _elastic_programs() -> tp.List[AuditProgram]:
     )]
 
 
+def _tensor_programs() -> tp.List[AuditProgram]:
+    """The megatron tensor x zero1 train step: every leaf
+    `tensor_state_sharding` declares sharded must compile — and live —
+    sharded (the silent fallback FT101 exists to catch is the
+    partitioner quietly replicating a column/row split it could not
+    propagate), the collective mix must contain the gradient reduction
+    and the param re-gather, and the step's call signatures must stay
+    stable across steps."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ...models import TransformerConfig, TransformerLM
+    from ...parallel.mesh import make_mesh
+    from ...parallel.tensor import tensor_state_sharding
+    from ...parallel.zero import audit_expectations
+
+    _require_devices(4)
+    n = len(jax.devices())
+    mesh = make_mesh({"tensor": 2, "data": -1})
+    cfg = TransformerConfig(vocab_size=128, dim=64, num_layers=2,
+                            num_heads=4, attention="dense",
+                            max_seq_len=32, dtype=jnp.float32)
+    model = TransformerLM(cfg, mesh=mesh)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    optim = optax.adamw(1e-3)
+    state = {"params": variables, "opt_state": optim.init(variables)}
+    spec = tensor_state_sharding(state, mesh, min_size=2 ** 8)
+    state = jax.device_put(state, spec)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    batch, seq = n, 16
+    rng = np.random.default_rng(0)
+    tokens_sharding = NamedSharding(mesh, P("data"))
+    batches = [jax.device_put(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+        tokens_sharding) for _ in range(2)]
+
+    def step(state_in: tp.Any, tokens: tp.Any) -> tp.Any:
+        def loss_fn(vs: tp.Any) -> tp.Any:
+            logits = model.apply(vs, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(state_in["params"])
+        updates, opt_state = optim.update(grads, state_in["opt_state"],
+                                          state_in["params"])
+        return ({"params": optax.apply_updates(state_in["params"], updates),
+                 "opt_state": opt_state}, {"loss": loss})
+
+    # out_shardings pinned to the declared spec, the `wrap` contract:
+    # that pin is what forces the data-sharded update math to re-gather
+    # the fresh params (the zero1 all-gather) instead of leaving them
+    # wherever propagation dropped them
+    jitted = jax.jit(step, in_shardings=(spec, tokens_sharding),
+                     out_shardings=(spec, None))
+    compiled = jitted.lower(state, batches[0]).compile()
+    state1, _ = jitted(state, batches[0])
+    return [AuditProgram(
+        label="tensor/tp-zero1-step",
+        compiled=compiled,
+        state=state1,
+        **audit_expectations(spec),
+        fn=step,
+        arg_sets=[(state, batches[0]), (state1, batches[1])],
+    )]
+
+
 def demo_programs(legs: tp.Sequence[str] = SWEEP_LEGS
                   ) -> tp.List[AuditProgram]:
     """Build the audit programs for the requested demo legs."""
     builders = {"zero": _zero_programs, "pipeline": _pipeline_programs,
-                "serve": _serve_programs, "elastic": _elastic_programs}
+                "serve": _serve_programs, "elastic": _elastic_programs,
+                "tensor": _tensor_programs}
     unknown = [leg for leg in legs if leg not in builders]
     if unknown:
         raise ValueError(f"unknown sweep leg(s) {unknown}; "

@@ -86,6 +86,31 @@ def _rotary(x: jax.Array, positions: jax.Array) -> jax.Array:
     ], axis=-1).astype(x.dtype)
 
 
+def _tp_boundary(x: jax.Array, mesh: tp.Any, *tail: tp.Any) -> jax.Array:
+    """Pin an activation's layout at a megatron layer boundary.
+
+    `tail` is the PartitionSpec beyond the [batch, time] dims:
+    'tensor' on the heads/hidden dim inside a block (column-parallel
+    outputs stay split, no collective), nothing at the block boundary
+    — where pinning the tensor-unsharded layout makes XLA lower the
+    row-parallel matmul's partial sums as THE all-reduce over
+    'tensor', one after attention and one after the MLP, exactly the
+    hand-written megatron pair. No-op without a mesh, at tensor width
+    1, or outside a trace (an eager `model.init` must not commit
+    device placements before the step's jit decides them).
+    """
+    if mesh is None or not isinstance(x, jax.core.Tracer):
+        return x
+    try:
+        if dict(mesh.shape).get("tensor", 1) <= 1:
+            return x
+    except Exception:  # mesh-like without named shape: nothing to pin
+        return x
+    from jax.sharding import NamedSharding
+    spec = P(("data", "fsdp"), None, *tail)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 class Attention(nn.Module):
     config: TransformerConfig
     mesh: tp.Any = None
@@ -97,6 +122,9 @@ class Attention(nn.Module):
         cfg = self.config
         qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim), axis=-1,
                               use_bias=False, dtype=cfg.dtype, name="qkv")(x)
+        # column-parallel output: heads stay split over 'tensor' so the
+        # whole attention body is head-local — no collective here
+        qkv = _tp_boundary(qkv, self.mesh, None, "tensor", None)
         q, k, v = (qkv[:, :, i] for i in range(3))  # [B, T, H, Dh]
         q = _rotary(q, positions)
         k = _rotary(k, positions)
@@ -135,6 +163,9 @@ class Attention(nn.Module):
 
         out = nn.DenseGeneral(cfg.dim, axis=(-2, -1), use_bias=False,
                               dtype=cfg.dtype, name="out")(out)
+        # row-parallel output: the contraction over 'tensor'-sharded
+        # heads left partial sums — this boundary IS the all-reduce
+        out = _tp_boundary(out, self.mesh)
         if cfg.dropout > 0.0:
             out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
         return out
@@ -142,6 +173,7 @@ class Attention(nn.Module):
 
 class MLPBlock(nn.Module):
     config: TransformerConfig
+    mesh: tp.Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -150,8 +182,13 @@ class MLPBlock(nn.Module):
         # Gated (SwiGLU-style) MLP: one fused up-projection, split in two.
         up = nn.Dense(2 * hidden, use_bias=False, dtype=cfg.dtype, name="up")(x)
         gate, value = jnp.split(up, 2, axis=-1)
+        # Constrain the gated product, not `up`: gate/value are each F
+        # wide and tensor-shard cleanly, whereas pinning the fused 2F
+        # output would put the split boundary mid-shard (an all-to-all).
+        h = _tp_boundary(nn.silu(gate) * value, self.mesh, "tensor")
         out = nn.Dense(cfg.dim, use_bias=False, dtype=cfg.dtype,
-                       name="down")(nn.silu(gate) * value)
+                       name="down")(h)
+        out = _tp_boundary(out, self.mesh)  # row-parallel: the MLP all-reduce
         if cfg.dropout > 0.0:
             out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
         return out
@@ -177,7 +214,7 @@ class Block(nn.Module):
                            dispatch=cfg.moe_dispatch, mesh=self.mesh,
                            dtype=cfg.dtype, name="moe")(normed)
         else:
-            x = x + MLPBlock(cfg, name="mlp")(normed, train)
+            x = x + MLPBlock(cfg, mesh=self.mesh, name="mlp")(normed, train)
         return x
 
 

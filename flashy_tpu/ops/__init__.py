@@ -23,7 +23,9 @@ from .losses import chunked_softmax_cross_entropy, lm_next_token_loss
 # in-memory cache) just because the package eagerly imported it.
 _TUNING_EXPORTS = (
     "lookup_tuned_blocks", "lookup_tuned_paged_blocks",
+    "lookup_tuned_bwd_blocks", "lookup_remat_policy",
     "tune_flash_blocks", "tune_paged_blocks",
+    "tune_flash_bwd_blocks", "search_remat_policy",
 )
 
 

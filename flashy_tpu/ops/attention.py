@@ -9,9 +9,17 @@
 #    VMEM with the online-softmax recurrence so the TxT score matrix
 #    never hits HBM, in forward AND backward. The forward kernel also
 #    emits the per-row logsumexp; the backward recomputes P blockwise
-#    from it in two kernels (dQ with K-blocks innermost; dK/dV with
-#    Q-blocks innermost), so training memory is O(T) in the sequence —
-#    the FlashAttention-2 decomposition, laid out for the MXU.
+#    from it. Two backward spellings share every block formula:
+#      - fused (default): ONE kernel sweeps (k-block, q-block) once,
+#        accumulating dK/dV in VMEM and emitting per-k-block f32 dQ
+#        partials that a fixed-order fold reduces outside — each
+#        Q/K/V/dO block is read from HBM once;
+#      - split (the oracle): two kernels (dQ with K-blocks innermost;
+#        dK/dV with Q-blocks innermost), reading everything twice.
+#    Both are O(T) in sequence memory — the FlashAttention-2
+#    decomposition, laid out for the MXU — and bit-identical to each
+#    other (tests pin it), so the split path doubles as the
+#    interpret-mode oracle for the fused one.
 #
 # Array convention: [batch, time, heads, head_dim] (flax-style).
 # The logsumexp rows are carried broadcast across a 128-wide lane dim
@@ -268,6 +276,81 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dk_ref, dv_ref, dqp_ref, dk_scr, dv_scr, *,
+                            scale: float, causal: bool, block_q: int,
+                            block_k: int, offset: int):
+    """Fused backward: grid (batch*head, k-block, q-block), q innermost.
+
+    One pass over the (k, q) block grid computes everything the two
+    split kernels compute, reading each Q/K/V/dO/lse/D block from HBM
+    once instead of twice: for a fixed k-block the q-blocks stream by
+    accumulating dK/dV in VMEM (exactly the split dK/dV kernel's
+    order), and the dQ contribution of the (q, k) pair — whose dS the
+    dK accumulation already paid for — is emitted as a per-k-block f32
+    partial. A TPU grid cannot revisit an output block
+    non-consecutively, so the split dQ kernel's qi-major VMEM
+    accumulation is impossible here; instead the partials land in a
+    [BH, nk_blocks, T_q, D] buffer (each block written exactly once;
+    causally skipped blocks write exact zeros) and are reduced outside
+    in k order — the same f32 addition sequence as the split kernel's
+    scratch, so the two paths agree bitwise.
+    """
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _accumulate():
+        scores = _block_scores(q_ref, k_ref, qi, ki, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, offset=offset)
+        lse = lse_ref[0, :, :1]
+        # Same empty-row guard as the split kernels.
+        probs = _guarded_probs(scores, lse)        # [block_q, block_k]
+        # P / dS cast to the operand dtype for bf16 MXU passes with f32
+        # accumulation; op-for-op the split kernels' formulas, in the
+        # split dK/dV kernel's order (dV, dP, dS, dK), so the VMEM
+        # accumulators march through identical f32 values.
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(   # P^T dO [block_k, D]
+            probs.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(                  # dO V^T [block_q, block_k]
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        delta = delta_ref[0, :, :1]
+        ds = probs * (dp - delta) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(   # dS^T Q [block_k, D]
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dqp_ref[0, 0] = jax.lax.dot_general(           # dS K [block_q, D]
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        visible = _causal_visible(qi, ki, block_q, block_k, offset)
+        pl.when(visible)(_accumulate)
+
+        @pl.when(jnp.logical_not(visible))
+        def _skipped():
+            # every (k, q) output block is written exactly once; a
+            # causally skipped pair must contribute exact zeros to the
+            # dQ fold, not stale VMEM garbage
+            dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+    else:
+        _accumulate()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
 try:  # pallas import is cheap but keep the module importable everywhere
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -405,24 +488,107 @@ def _flash_backward(q, k, v, out, lse, grad_out, *, causal: bool,
             _unfold(dv, batch, heads))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+def _flash_backward_fused(q, k, v, out, lse, grad_out, *, causal: bool,
+                          block_q: int, block_k: int, interpret: bool,
+                          delta=None):
+    """One-pass flash backward (`_flash_bwd_fused_kernel`): half the
+    HBM reads of `_flash_backward` at the cost of nk_blocks f32 dQ
+    partials, bit-identical results (the split path is the oracle)."""
+    batch, t_q, heads, dim = q.shape
+    t_k = k.shape[1]
+    scale = 1.0 / np.sqrt(dim)
+    offset = t_k - t_q
+    bh = batch * heads
+    nk = t_k // block_k
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    dof = _fold(grad_out)
+
+    if delta is None:
+        # D = rowsum(dO * O): same XLA precompute as the split path
+        # (identical f32 values feed both backends).
+        delta = jnp.sum(dof.astype(jnp.float32)
+                        * _fold(out).astype(jnp.float32), axis=-1)  # [BH, T_q]
+        delta = jnp.broadcast_to(delta[:, :, None], (bh, t_q, LANES))
+
+    col_specs = [
+        pl.BlockSpec((1, block_q, dim), lambda b, ki, qi: (b, qi, 0)),    # q
+        pl.BlockSpec((1, block_k, dim), lambda b, ki, qi: (b, ki, 0)),    # k
+        pl.BlockSpec((1, block_k, dim), lambda b, ki, qi: (b, ki, 0)),    # v
+        pl.BlockSpec((1, block_q, dim), lambda b, ki, qi: (b, qi, 0)),    # dO
+        pl.BlockSpec((1, block_q, LANES), lambda b, ki, qi: (b, qi, 0)),  # lse
+        pl.BlockSpec((1, block_q, LANES), lambda b, ki, qi: (b, qi, 0)),  # D
+    ]
+    vma = _compat.vma_of(q)
+    dk, dv, dqp = pl.pallas_call(
+        functools.partial(_flash_bwd_fused_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, offset=offset),
+        grid=(bh, nk, t_q // block_q),
+        in_specs=col_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, dim), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, dim),
+                         lambda b, ki, qi: (b, ki, qi, 0)),
+        ],
+        out_shape=[
+            _compat.shape_dtype_struct((bh, t_k, dim), k.dtype, vma=vma),
+            _compat.shape_dtype_struct((bh, t_k, dim), v.dtype, vma=vma),
+            _compat.shape_dtype_struct((bh, nk, t_q, dim), jnp.float32,
+                                       vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dim), jnp.float32),
+            pltpu.VMEM((block_k, dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # Reduce the dQ partials with an explicit left fold in k order —
+    # the exact f32 addition sequence of the split kernel's VMEM
+    # accumulator (which starts from zeros and adds k-blocks in order),
+    # so fused and split dQ agree bitwise. jnp.sum's reduction order
+    # would be XLA's choice, not ours.
+    dq = jnp.zeros((bh, t_q, dim), jnp.float32)
+    for i in range(nk):
+        dq = dq + dqp[:, i]
+    dq = dq.astype(q.dtype)
+    return (_unfold(dq, batch, heads), _unfold(dk, batch, heads),
+            _unfold(dv, batch, heads))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, fused):
     out, _ = _flash_forward(q, k, v, causal=causal, block_q=block_q,
                             block_k=block_k, interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, fused):
     out, lse = _flash_forward(q, k, v, causal=causal, block_q=block_q,
                               block_k=block_k, interpret=interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, grad_out):
+def _flash_bwd(causal, block_q, block_k, interpret, fused, residuals,
+               grad_out):
     q, k, v, out, lse = residuals
-    return _flash_backward(q, k, v, out, lse, grad_out, causal=causal,
-                           block_q=block_q, block_k=block_k,
-                           interpret=interpret)
+    t_q, t_k = q.shape[1], k.shape[1]
+    bq, bk = block_q, block_k
+    if t_q == t_k:
+        # Training tiles tuned separately from the forward's: the
+        # backward runs 2-3 matmuls per block pair against the
+        # forward's two, so its VMEM sweet spot differs. Cache-only at
+        # trace time (the lookup_tuned_blocks convention); a tuned pair
+        # that does not divide this sequence keeps the forward's tiles.
+        from .tuning import lookup_tuned_bwd_blocks
+        tuned = lookup_tuned_bwd_blocks(q.shape[0], t_q, q.shape[2],
+                                        q.shape[3], causal=causal,
+                                        dtype=q.dtype)
+        if tuned is not None and t_q % tuned[0] == 0 and t_k % tuned[1] == 0:
+            bq, bk = tuned
+    backward = _flash_backward_fused if fused else _flash_backward
+    return backward(q, k, v, out, lse, grad_out, causal=causal,
+                    block_q=bq, block_k=bk, interpret=interpret)
 
 
 if _PALLAS_AVAILABLE:
@@ -444,14 +610,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, *,
                     block_q: tp.Optional[int] = None,
                     block_k: tp.Optional[int] = None,
-                    interpret: tp.Optional[bool] = None) -> jax.Array:
+                    interpret: tp.Optional[bool] = None,
+                    fused_backward: tp.Optional[bool] = None) -> jax.Array:
     """Flash attention over [B, T, H, D]; pallas on TPU, XLA elsewhere.
 
     Forward and backward are pallas kernels (O(T) sequence memory; the
     backward recomputes P blockwise from the forward's logsumexp — the
-    FlashAttention-2 decomposition). Block sizes default to a tuned
-    table when one exists for this (device, shape) — populated by
-    `ops.tune_flash_blocks` / the bench / `tools/tpu_validate.py` —
+    FlashAttention-2 decomposition). The backward defaults to the
+    fused one-pass kernel (`fused_backward=None` -> True: each
+    Q/K/V/dO block read from HBM once); `fused_backward=False` selects
+    the split two-kernel path, kept as the bit-identical oracle (the
+    paged-decode `--kernel gather` convention). Block sizes default to
+    a tuned table when one exists for this (device, shape) — populated
+    by `ops.tune_flash_blocks` / the bench / `tools/tpu_validate.py` —
     else 256; they are clamped to the sequence length, and when the
     requested block does not divide T, the largest dividing multiple
     of 128 (up to 512) is used instead, so e.g. T=384 runs the kernel
@@ -485,4 +656,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         else:
             # tpu, or TPU PJRT plugins under other names: real kernel.
             interpret = False
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    if fused_backward is None:
+        fused_backward = True
+    return _flash(q, k, v, causal, block_q, block_k, interpret,
+                  fused_backward)

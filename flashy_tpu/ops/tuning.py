@@ -255,6 +255,168 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int, *,
 
 
 # ----------------------------------------------------------------------
+# flash backward (training) tiles: the backward kernel runs 2-3
+# matmuls per block pair against the forward's two, so its VMEM sweet
+# spot can differ from the forward winner — tuned under its own
+# "flash_bwd" key and consulted by the custom-vjp backward at trace
+# time (cache-only, the lookup_tuned_blocks convention).
+# ----------------------------------------------------------------------
+def _flash_bwd_key(batch: int, seq_len: int, heads: int, head_dim: int,
+                   causal: bool, dtype: tp.Any) -> tp.Tuple:
+    return _make_key("flash_bwd", batch, seq_len, heads, head_dim, causal,
+                     str(jnp.dtype(dtype)))
+
+
+def lookup_tuned_bwd_blocks(batch: int, seq_len: int, heads: int,
+                            head_dim: int, *, causal: bool = True,
+                            dtype: tp.Any = jnp.bfloat16
+                            ) -> tp.Optional[tp.Tuple[int, int]]:
+    """Cache-only lookup of tuned backward (block_q, block_k) — NEVER
+    sweeps. None on a miss (the backward then reuses the forward's
+    tiles). Keyed "flash_bwd", disjoint from the forward's "flash" key
+    space: the winners answer different questions."""
+    try:
+        key = _flash_bwd_key(batch, seq_len, heads, head_dim, causal, dtype)
+    except Exception:  # devices not initialized / no backend
+        return None
+    return _coerce_pair(_lookup(key))
+
+
+def tune_flash_bwd_blocks(batch: int, seq_len: int, heads: int,
+                          head_dim: int, *, causal: bool = True,
+                          dtype: tp.Any = jnp.bfloat16,
+                          candidates: tp.Sequence[tp.Tuple[int, int]]
+                          = CANDIDATES,
+                          reps: int = 5,
+                          interpret: tp.Optional[bool] = None
+                          ) -> tp.Tuple[int, int]:
+    """Measure BACKWARD-pass tile candidates; return + persist the winner.
+
+    The timed program is the gradient alone (vjp of a precomputed
+    forward — what the training step's backward actually pays), with
+    the fused one-pass backward kernel at each candidate tile. On CPU
+    without explicit `interpret=True` the default (256, 256) is
+    returned unswept — interpret-mode timings are meaningless, the
+    `tune_flash_blocks` convention.
+    """
+    from .attention import flash_attention
+
+    key = _flash_bwd_key(batch, seq_len, heads, head_dim, causal, dtype)
+    hit = _coerce_pair(_lookup(key))
+    if hit is not None:
+        return hit
+    disk_key = "/".join(str(part) for part in key)
+
+    viable = [(bq, bk) for bq, bk in candidates
+              if seq_len % bq == 0 and seq_len % bk == 0]
+    if (jax.default_backend() == "cpu" and not interpret) or not viable:
+        return (256, 256)
+
+    shape = (batch, seq_len, heads, head_dim)
+    q = jnp.ones(shape, dtype)
+    k = jnp.ones(shape, dtype)
+    v = jnp.ones(shape, dtype)
+
+    def build(bq: int, bk: int) -> tp.Callable[[], tp.Any]:
+        def loss(q, k, v):
+            return flash_attention(q, k, v, causal=causal, block_q=bq,
+                                   block_k=bk, interpret=interpret) \
+                .astype(jnp.float32).sum()
+
+        grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return lambda: grad(q, k, v)
+
+    timings: tp.Dict[tp.Tuple[int, int], float] = {}
+    for bq, bk in viable:
+        try:
+            timings[(bq, bk)] = _time_call(build(bq, bk), reps)
+        except Exception as exc:  # tile too large for VMEM, etc.
+            logger.debug("flash bwd tune: (%d, %d) failed: %s", bq, bk, exc)
+    if not timings:
+        return (256, 256)
+    best = min(timings, key=timings.get)  # type: ignore[arg-type]
+    logger.info("flash bwd tune %s: best blocks %s (%.3f ms); swept %d "
+                "candidates", key, best, timings[best] * 1e3, len(timings))
+    _cache[key] = best
+    _store_disk_cache(disk_key, best)
+    return best
+
+
+# ----------------------------------------------------------------------
+# remat-policy search: which transformer.py remat_policy a stage should
+# run is a measurement, not a guess — 'dots' keeps most of the no-remat
+# speed at a fraction of the activation HBM, but the winner depends on
+# whether the stage is compute- or HBM-bound on THIS chip at THIS shape.
+# ----------------------------------------------------------------------
+REMAT_POLICIES: tp.Tuple[str, ...] = ("full", "dots", "dots_no_batch")
+
+
+def _remat_key(stage: str, *parts: tp.Any) -> tp.Tuple:
+    return _make_key("remat_policy", stage, *parts)
+
+
+def _coerce_choice(hit: tp.Any,
+                   choices: tp.Sequence[str]) -> tp.Optional[str]:
+    """Disk value -> a known policy name, or None on corruption. The
+    winner IS a string here, so (unlike `_coerce_int`) strings are
+    valid — but only ones naming a policy this runtime knows."""
+    return hit if isinstance(hit, str) and hit in choices else None
+
+
+def lookup_remat_policy(stage: str, *parts: tp.Any) -> tp.Optional[str]:
+    """Cache-only lookup of a recorded remat-policy winner — NEVER
+    sweeps. `stage` names the timed program (e.g. 'lm_block'); `parts`
+    carry its geometry (dim, layers, seq, batch...). None on a miss."""
+    try:
+        key = _remat_key(stage, *parts)
+    except Exception:  # devices not initialized / no backend
+        return None
+    return _coerce_choice(_lookup(key), REMAT_POLICIES)
+
+
+def search_remat_policy(build_step: tp.Callable[[str],
+                                                tp.Callable[[], tp.Any]],
+                        stage: str, *parts: tp.Any,
+                        policies: tp.Sequence[str] = REMAT_POLICIES,
+                        reps: int = 3,
+                        allow_cpu: bool = False) -> str:
+    """Time `build_step(policy)()` per candidate policy; record the
+    winner under the "remat_policy" key for `lookup_remat_policy`.
+
+    `build_step` returns the timeable thunk for one policy — typically
+    a jitted grad step of a TransformerLM built with
+    `dataclasses.replace(cfg, remat=True, remat_policy=policy)`. On
+    CPU the sweep is skipped (timings there do not predict the TPU
+    winner) and 'dots' — the policy that keeps matmul outputs — is
+    returned unrecorded, unless `allow_cpu=True` (mechanism tests).
+    """
+    unknown = [p for p in policies if p not in REMAT_POLICIES]
+    if unknown:
+        raise ValueError(f"unknown remat policies {unknown}; "
+                         f"pick from {list(REMAT_POLICIES)}")
+    key = _remat_key(stage, *parts)
+    hit = _coerce_choice(_lookup(key), REMAT_POLICIES)
+    if hit is not None:
+        return hit
+    if jax.default_backend() == "cpu" and not allow_cpu:
+        return "dots"
+    timings: tp.Dict[str, float] = {}
+    for policy in policies:
+        try:
+            timings[policy] = _time_call(build_step(policy), reps)
+        except Exception as exc:  # policy OOMs / fails to lower
+            logger.debug("remat search %s: %r failed: %s", stage, policy, exc)
+    if not timings:
+        return "dots"
+    best = min(timings, key=timings.get)  # type: ignore[arg-type]
+    logger.info("remat search %s%s: best %r (%.3f ms); swept %d policies",
+                stage, parts, best, timings[best] * 1e3, len(timings))
+    _cache[key] = best
+    _store_disk_cache("/".join(str(part) for part in key), best)
+    return best
+
+
+# ----------------------------------------------------------------------
 # fused paged-decode kernel (ops/paged_decode.py): head_block tuning
 # ----------------------------------------------------------------------
 def _paged_key(batch: int, queries: int, heads: int, head_dim: int,

@@ -32,6 +32,9 @@ from .pipeline import pipeline, pipeline_1f1b
 _LAZY_EXPORTS = {
     "zero_sharding": "zero", "zero_update": "zero",
     "per_device_bytes": "zero", "describe_state_sharding": "zero",
+    # tensor parallelism: same CLI-module rule as zero
+    "tensor_state_sharding": "tensor", "validate_tensor_args": "tensor",
+    "flash_bwd_parity": "tensor",
     "build_1f1b_schedule": "schedules", "schedule_stats": "schedules",
     "bubble_fraction": "schedules", "gpipe_bubble_fraction": "schedules",
     # the numerics-audit program registry (analysis --numerics sweep);

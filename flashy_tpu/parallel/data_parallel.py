@@ -63,26 +63,64 @@ def shard_batch(batch: tp.Any, mesh: tp.Optional[Mesh] = None,
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
 
 
-def axis_leaf_sharding(mesh: Mesh, axis: str,
-                       min_size: int) -> tp.Callable[[tp.Any], NamedSharding]:
+def axis_leaf_sharding(mesh: Mesh, axis: str, min_size: int,
+                       base: tp.Optional[tp.Callable[[tp.Any], P]] = None
+                       ) -> tp.Callable[[tp.Any], NamedSharding]:
     """Leaf rule shared by `fsdp_sharding` (axis='fsdp') and
     `zero.zero_sharding` (axis='data'): shard the largest dimension
     divisible by the axis size; leaves below `min_size` elements stay
     replicated (sharding tiny arrays costs more in collective latency
-    than it saves in HBM)."""
+    than it saves in HBM).
+
+    `base` composes a second parallelism dimension through the same
+    seam: a callable returning the PartitionSpec a leaf ALREADY
+    carries (the megatron column/row splits of `tensor.py`'s
+    `transformer_shardings`). The rule then shards the largest
+    divisible dim NOT claimed by the base spec and merges the two — a
+    qkv kernel tensor-split on its heads dim gets its zero1 'data'
+    shard on the model dim, so per-chip update state scales
+    ~1/(data*tensor) under the composed mesh."""
     axis_size = mesh.shape[axis]
 
     def leaf_sharding(x) -> NamedSharding:
         shape = np.shape(x)
-        if axis_size > 1 and np.size(x) >= min_size:
+        if base is None:
+            spec: tp.List[tp.Any] = [None] * len(shape)
+        else:
+            spec = list(base(x))
+            spec += [None] * (len(shape) - len(spec))
+        used = {name for part in spec if part is not None
+                for name in (part if isinstance(part, tuple) else (part,))}
+        if axis_size > 1 and np.size(x) >= min_size and axis not in used:
             # Prefer sharding the largest divisible dim.
             order = sorted(range(len(shape)), key=lambda i: -shape[i])
             for dim in order:
-                if shape[dim] % axis_size == 0:
-                    spec = [None] * len(shape)
+                if spec[dim] is None and shape[dim] % axis_size == 0:
                     spec[dim] = axis
-                    return NamedSharding(mesh, P(*spec))
-        return NamedSharding(mesh, P())
+                    break
+            else:
+                # Every divisible dim is claimed by the base spec (a 2D
+                # megatron matrix carries tensor AND fsdp): ride along
+                # an already-sharded dim — the HSDP spelling ('fsdp',
+                # 'data') — wherever the composed shard still divides.
+                # Without this, exactly the biggest MLP/embedding
+                # moments would stay at 1/tensor instead of
+                # 1/(tensor*data), which FT101's live-bytes gate flags.
+                for dim in order:
+                    part = spec[dim]
+                    if part is None:
+                        continue
+                    parts = part if isinstance(part, tuple) else (part,)
+                    span = axis_size * int(
+                        np.prod([mesh.shape[p] for p in parts]))
+                    if shape[dim] % span == 0:
+                        spec[dim] = (*parts, axis)
+                        break
+        if base is None and not any(part is not None for part in spec):
+            # exact historical spelling: a replicated leaf is P(), not
+            # an all-None spec of matching rank
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, P(*spec))
 
     return leaf_sharding
 

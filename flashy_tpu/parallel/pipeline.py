@@ -906,6 +906,40 @@ def run_pipeline_bench(steps: int = 3, *, num_micro: int = 8,
         pipe, 2 * num_micro, mb_shape)
     result["stash_flat_in_m"] = (result["stash_bytes_at_2m"]
                                  == result["stash_bytes_at_m"])
+
+    # tensor x pipe composition probe (the 3D-mesh claim, kept cheap):
+    # the same 1F1B schedule with the stage weights ALSO column-split
+    # over 'tensor' must reproduce the pipe-only run's loss and grads —
+    # the megatron partial-sum reduction and the stage ppermute ring
+    # compose in one jit, or this delta says where they stopped.
+    if n_devices % 4 == 0:
+        from jax.sharding import NamedSharding
+
+        tmesh = make_mesh({"pipe": 2, "tensor": 2, "data": -1})
+        w = 0.1 * jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16),
+                                    jnp.float32)
+        x = jnp.ones((4, 16), jnp.float32)
+
+        def _compose_run(spec: P) -> tp.Tuple[float, tp.List[np.ndarray]]:
+            params = jax.device_put({"w": w}, NamedSharding(tmesh, spec))
+            loss, grads = pipeline_1f1b(
+                lambda p, h: jnp.tanh(h @ p["w"]), params, x,
+                loss_fn=lambda lp, h: (h ** 2).mean(), mesh=tmesh,
+                num_microbatches=2)
+            return (float(loss),
+                    [np.asarray(g)
+                     for g in jax.tree_util.tree_leaves(grads)])
+
+        base_loss, base_grads = _compose_run(P("pipe"))
+        tp_loss, tp_grads = _compose_run(P("pipe", None, "tensor"))
+        grad_delta = max(float(np.max(np.abs(a - b)))
+                         for a, b in zip(tp_grads, base_grads))
+        result["tensor_compose"] = {
+            "ok": bool(tp_loss == base_loss and grad_delta < 1e-6),
+            "loss_delta": abs(tp_loss - base_loss),
+            "grad_delta": grad_delta,
+        }
+
     result["recompiles"] = sum(watchdog.summary().values())
     return result
 
@@ -1015,6 +1049,12 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
     if not result["bubble_track_recorded"]:
         problems.append("pipeline/bubble counter track missing from "
                         "telemetry.jsonl")
+    compose = result.get("tensor_compose")
+    if compose is not None and not compose["ok"]:
+        problems.append(
+            f"tensor x pipe composition diverged from the pipe-only "
+            f"run: loss delta {compose['loss_delta']:.2e}, grad delta "
+            f"{compose['grad_delta']:.2e}")
     for problem in problems:
         print(f"pipeline bench FAILED: {problem}", file=sys.stderr)
     return 1 if problems else 0

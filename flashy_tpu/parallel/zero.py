@@ -210,12 +210,26 @@ def describe_state_sharding(state: tp.Any) -> tp.Dict[str, tp.Any]:
     Returns `{'mode', 'param_axes', 'update_axes', 'axis_sizes',
     'summary'}` where mode is one of:
 
-      * ``replicated`` — no leaf is sharded (ZeRO-0)
-      * ``zero1``      — params replicated, optimizer/master state
-                         sharded (ZeRO-1/2, this module's pattern)
-      * ``fsdp``       — the parameters themselves are sharded (ZeRO-3)
+      * ``replicated``   — no leaf is sharded (ZeRO-0)
+      * ``zero1``        — params replicated, optimizer/master state
+                           sharded (ZeRO-1/2, this module's pattern)
+      * ``fsdp``         — the parameters themselves are sharded over a
+                           non-model axis (ZeRO-3)
+      * ``tensor``       — megatron column/row splits over the 'tensor'
+                           axis only (`parallel.tensor`)
+      * ``tensor+zero1`` — tensor splits on the params, PLUS update
+                           state sharded over a data-ish axis the
+                           params do not use (the 2D/3D composition)
+      * ``tensor+fsdp``  — tensor splits composed with parameter
+                           sharding over 'fsdp'
 
-    Grouping follows `UPDATE_KEY_MARKERS` on the top-level state key.
+    Axes of mesh size 1 are ignored throughout: a spec naming a
+    size-1 axis IS replication (an elastic restore onto a
+    tensor-width-1 mesh must classify by what is genuinely split
+    there, not by the spelling the checkpoint carried) — except on a
+    1-device mesh, where the declared layout is all there is and the
+    spelling classifies (shrink-to-world-1 stays "zero1"). Grouping
+    follows `UPDATE_KEY_MARKERS` on the top-level state key.
     `BaseSolver.commit` persists this next to the checkpoint
     (`checkpoint_meta.json`) so `python -m flashy_tpu.info` can show how
     a restored solver's state is laid out.
@@ -226,9 +240,20 @@ def describe_state_sharding(state: tp.Any) -> tp.Dict[str, tp.Any]:
 
     def visit(path, leaf):
         axes, sizes = _leaf_axes(leaf)
+        # a size-1 mesh axis shards nothing; treating it as sharded
+        # would misreport e.g. restore@(data=8, tensor=1) as tensor-
+        # parallel (unknown sizes — no mesh on the sharding — count).
+        # EXCEPT on a 1-device mesh, where every axis is degenerate:
+        # there the declared logical layout is the only information
+        # (an elastic shrink to world 1 is still "zero1", and grows
+        # back as one), so the spelling wins.
+        mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+        if mesh is None or mesh.size > 1:
+            axes = {name for name in axes if sizes.get(name, 2) != 1}
         if not axes:
             return
-        axis_sizes.update(sizes)
+        axis_sizes.update({name: size for name, size in sizes.items()
+                           if name in axes})
         # A leaf is update state when ANY pytree key on its path names
         # it (a solver may register 'opt_state' directly, or one
         # combined attribute {'params': ..., 'opt_state': ...} — the
@@ -240,7 +265,17 @@ def describe_state_sharding(state: tp.Any) -> tp.Dict[str, tp.Any]:
         (update_axes if is_update else param_axes).update(axes)
 
     jax.tree_util.tree_map_with_path(visit, state)
-    if param_axes:
+    if "tensor" in (param_axes | update_axes):
+        # model-parallel axes on the params are the tensor layout, not
+        # fsdp; what rides on top decides the suffix
+        if param_axes - {"tensor", "pipe", "expert", "seq"}:
+            mode = "tensor+fsdp"
+        elif update_axes - param_axes - {"tensor", "pipe", "expert", "seq"}:
+            mode = "tensor+zero1"
+        else:
+            mode = "tensor"
+        axes = param_axes | update_axes
+    elif param_axes:
         mode = "fsdp"
         axes = param_axes | update_axes
     elif update_axes:

@@ -667,6 +667,51 @@ class PipelineScenario(Scenario):
             raise CampaignFailure(failures)
 
 
+class TensorScenario(Scenario):
+    """Megatron tensor-parallel training (`parallel.tensor`). Only
+    `delay` applies: `tensor.step` is a host-side tick between jitted
+    steps, so a raise there surfaces cleanly before any collective
+    launches; what the campaign pins is that a stalled host tick
+    changes nothing numerically. Oracle: the per-width loss trajectory
+    bit-identical to the clean run, and still zero post-warm-up
+    recompiles."""
+
+    name = "tensor"
+
+    def sites(self):
+        return {"tensor.step": ("delay",)}
+
+    def unavailable(self):
+        try:
+            import jax
+        except Exception as exc:  # pragma: no cover - jax is baked in
+            return f"jax unavailable: {exc}"
+        if len(jax.devices()) < 8:
+            return ("needs 8 virtual devices; run under XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=8 "
+                    "JAX_PLATFORMS=cpu (what `make chaos-campaign` does)")
+        return None
+
+    def execute(self, run_dir, corrupt=(), calibrate=False):
+        from ..parallel.tensor import run_tp_bench
+
+        result = run_tp_bench(steps=2, dim=32, num_layers=1, num_heads=4,
+                              vocab_size=64, seq=16, widths=(2,))
+        trajectory = result["loss_trajectory"]
+
+        failures: tp.List[str] = []
+        if calibrate:
+            self._baseline = trajectory
+        else:
+            self._check(failures, trajectory == self._baseline,
+                        f"loss trajectory diverged from the clean run "
+                        f"({trajectory} vs {self._baseline})")
+        self._check(failures, result["recompiles"] == 0,
+                    f"{result['recompiles']} post-warm-up recompiles")
+        if failures:
+            raise CampaignFailure(failures)
+
+
 class ElasticScenario(Scenario):
     """Elastic resume across a world-size change (2 -> 1 virtual
     devices), so `ckpt.reshard` and `datapipe.resplit` genuinely fire
@@ -741,7 +786,8 @@ def builtin_scenarios() -> tp.List[Scenario]:
     """All scenario adapters, cheapest first (construction is lazy and
     jax-free — safe for `python -m flashy_tpu.info --faults`)."""
     return [TrainScenario(), DatapipeScenario(), ServeScenario(),
-            FleetScenario(), PipelineScenario(), ElasticScenario()]
+            FleetScenario(), PipelineScenario(), TensorScenario(),
+            ElasticScenario()]
 
 
 def static_coverage() -> tp.Dict[str, tp.Dict[str, tp.Tuple[str, ...]]]:

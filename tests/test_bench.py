@@ -190,6 +190,13 @@ def test_compact_line_fits_driver_tail_worst_case():
         # keeps any real overflow inside MAX_LINE_CHARS by trimming
         # detail — the convention since the spec/paged sublegs landed.
         "fused_vs_gather": 12.345,
+        # the lm tensor-parallel subleg scalars at maximal width, plus
+        # the pipeline leg's 3D-composition flag — every key
+        # _COMPACT_KEYS whitelists must be priced into the budget
+        "tp_step_ms_t1": 12345.67, "tp_step_ms_t2": 12345.67,
+        "tp_step_ms_t4": 12345.67, "tp_opt_bytes_ratio": 0.1259,
+        "tp_flash_bwd_parity": 0.000123, "flash_bwd_vs_unfused": 12.345,
+        "tensor_compose_ok": False,
         "leg_platform": "tpu",
         "comparison": {"tokens_per_sec_per_chip": 39483.2},
     }

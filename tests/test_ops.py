@@ -321,9 +321,10 @@ def test_flash_attention_uses_tuned_blocks(tmp_path, monkeypatch):
     seen = []
     real = attention._flash
 
-    def spy(q, k, v, causal, block_q, block_k, interpret):
+    def spy(q, k, v, causal, block_q, block_k, interpret, fused_backward):
         seen.append((block_q, block_k))
-        return real(q, k, v, causal, block_q, block_k, interpret)
+        return real(q, k, v, causal, block_q, block_k, interpret,
+                    fused_backward)
 
     monkeypatch.setattr(attention, "_flash", spy)
     q = jnp.ones((1, 256, 2, 16), jnp.bfloat16)
@@ -390,3 +391,148 @@ class TestChunkedCrossEntropy:
         model, params, tokens = self._setup()
         with pytest.raises(ValueError, match="mode"):
             lm_next_token_loss(model, params, tokens, mode="bogus")
+
+
+# ----------------------------------------------------------------------
+# fused one-pass flash backward: BIT parity against the split
+# dq/dkv-kernel oracle (the tp-demo gate). The fused kernel replays the
+# split pair's accumulation order op for op, so np.array_equal — not
+# allclose — is the contract; any nonzero delta is a kernel bug.
+# ----------------------------------------------------------------------
+def _flash_grads(q, k, v, *, causal, block_q, block_k, fused):
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, causal=causal, block_q=block_q,
+                              block_k=block_k, fused_backward=fused)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+@pytest.mark.parametrize("shape_q,shape_k,causal,blocks,dtype", [
+    ((2, 128, 2, 64), (2, 128, 2, 64), True, (64, 64), jnp.float32),
+    ((1, 64, 2, 32), (1, 128, 2, 32), False, (32, 64), jnp.float32),
+    ((1, 128, 2, 32), (1, 128, 2, 32), True, (64, 32), jnp.bfloat16),
+])
+def test_flash_fused_backward_bit_identical_to_split(shape_q, shape_k,
+                                                     causal, blocks, dtype):
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal(shape_q), dtype)
+    k = jnp.asarray(rng.standard_normal(shape_k), dtype)
+    v = jnp.asarray(rng.standard_normal(shape_k), dtype)
+    block_q, block_k = blocks
+    fused = _flash_grads(q, k, v, causal=causal, block_q=block_q,
+                         block_k=block_k, fused=True)
+    split = _flash_grads(q, k, v, causal=causal, block_q=block_q,
+                         block_k=block_k, fused=False)
+    for a, b in zip(fused, split):
+        assert a.dtype == b.dtype
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tune_flash_bwd_blocks_sweeps_and_caches(tmp_path, monkeypatch):
+    # mechanism test, the tune_flash_blocks convention: sweep once,
+    # then memory cache, then (cleared) the disk cache — and the
+    # cache-only lookup the custom-vjp backward consults must see the
+    # recorded winner without ever sweeping itself
+    import flashy_tpu.ops.tuning as tuning
+    monkeypatch.setenv("FLASHY_TPU_TUNE_CACHE", str(tmp_path / "cache.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    tuning._cache.clear()
+
+    calls = []
+    real = tuning._time_call
+
+    def counting(fn, reps=1):
+        calls.append(1)
+        return real(fn, reps=1)
+
+    monkeypatch.setattr(tuning, "_time_call", counting)
+    # cache-only lookup on a cold cache: miss, no sweep
+    assert tuning.lookup_tuned_bwd_blocks(1, 128, 2, 16, causal=True,
+                                          dtype=jnp.float32) is None
+    assert not calls
+
+    best = tuning.tune_flash_bwd_blocks(
+        1, 128, 2, 16, causal=True, dtype=jnp.float32,
+        candidates=[(64, 64), (128, 128)], interpret=True)
+    assert best in [(64, 64), (128, 128)]
+    assert len(calls) == 2  # both viable candidates measured
+
+    # the lookup now returns the winner (and still never sweeps)
+    assert tuning.lookup_tuned_bwd_blocks(
+        1, 128, 2, 16, causal=True, dtype=jnp.float32) == best
+    assert len(calls) == 2
+
+    # second tune call: memory cache, no sweeping
+    best2 = tuning.tune_flash_bwd_blocks(
+        1, 128, 2, 16, causal=True, dtype=jnp.float32,
+        candidates=[(64, 64), (128, 128)], interpret=True)
+    assert best2 == best and len(calls) == 2
+
+    # fresh process simulation: memory cache cleared, disk cache hits
+    tuning._cache.clear()
+    assert tuning.lookup_tuned_bwd_blocks(
+        1, 128, 2, 16, causal=True, dtype=jnp.float32) == best
+    assert len(calls) == 2
+
+
+def test_tune_flash_bwd_blocks_cpu_returns_default():
+    from flashy_tpu.ops.tuning import tune_flash_bwd_blocks
+    # no interpret flag on CPU: unswept default, the forward convention
+    assert tune_flash_bwd_blocks(1, 256, 2, 16) == (256, 256)
+
+
+def test_search_remat_policy_records_winner(tmp_path, monkeypatch):
+    import flashy_tpu.ops.tuning as tuning
+    monkeypatch.setenv("FLASHY_TPU_TUNE_CACHE", str(tmp_path / "cache.json"))
+    tuning._cache.clear()
+
+    swept = []
+
+    def fake_time(fn, reps=1):
+        swept.append(fn.policy)
+        return {"full": 3.0, "dots": 1.0, "dots_no_batch": 2.0}[fn.policy]
+
+    monkeypatch.setattr(tuning, "_time_call", fake_time)
+
+    def build_step(policy):
+        def thunk():
+            return None
+        thunk.policy = policy
+        return thunk
+
+    # cache-only lookup on a cold cache: miss
+    assert tuning.lookup_remat_policy("lm", 128, 4) is None
+    # allow_cpu=True forces the sweep on the CPU backend (mechanism
+    # test; the production path skips it and returns 'dots' unswept)
+    best = tuning.search_remat_policy(build_step, "lm", 128, 4,
+                                      allow_cpu=True)
+    assert best == "dots" and sorted(swept) == sorted(tuning.REMAT_POLICIES)
+
+    # the winner is recorded for the cache-only lookup, and a second
+    # search returns it without re-timing
+    assert tuning.lookup_remat_policy("lm", 128, 4) == "dots"
+    swept.clear()
+    assert tuning.search_remat_policy(build_step, "lm", 128, 4,
+                                      allow_cpu=True) == "dots"
+    assert not swept
+
+    # disk round trip: memory cache cleared, the lookup still hits
+    tuning._cache.clear()
+    assert tuning.lookup_remat_policy("lm", 128, 4) == "dots"
+
+
+def test_search_remat_policy_rejects_unknown_policy():
+    from flashy_tpu.ops.tuning import search_remat_policy
+    with pytest.raises(ValueError, match="unknown remat policies"):
+        search_remat_policy(lambda p: (lambda: None), "lm",
+                            policies=("dots", "bogus"))
+
+
+def test_search_remat_policy_cpu_skips_sweep(monkeypatch):
+    import flashy_tpu.ops.tuning as tuning
+    tuning._cache.clear()
+    monkeypatch.setattr(tuning, "_time_call",
+                        lambda fn, reps=1: pytest.fail("swept on CPU"))
+    assert tuning.search_remat_policy(
+        lambda p: (lambda: None), "lm_cpu_skip", 1) == "dots"

@@ -480,3 +480,74 @@ def test_ft101_elastic_catches_silent_full_replication(tmp_path):
         sharded_bytes_ratio=1.0 / (n // 2) + 0.25)
     findings = audit_programs([program], select=["FT101"])
     assert {f.key for f in findings} == {"per-device-bytes"}
+
+
+# ----------------------------------------------------------------------
+# FT101 tensor leg: the tensor x zero1 composed layout audit
+# ----------------------------------------------------------------------
+def test_sweep_tensor_leg_programs():
+    # the live tensor sweep leg: one jitted train step with the megatron
+    # column/row param specs composed with the zero1 update shard — the
+    # declared layouts, the collective mix, and the live bytes all clean
+    programs = demo_programs(legs=("tensor",))
+    assert [p.label for p in programs] == ["tensor/tp-zero1-step"]
+    assert audit_programs(programs, select=["FT101"]) == []
+
+
+def test_ft101_catches_tensor_replication_fallback():
+    # the planted defect: a train state DECLARED tensor+zero1-sharded
+    # (the tensor_state_sharding spec) but built without a mesh and
+    # placed fully replicated — the "forgot to pass mesh=" failure.
+    # The layouts, the per-chip bytes, and the absent megatron
+    # all-reduce/zero1 all-gather must all flag.
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from flashy_tpu.models import TransformerConfig, TransformerLM
+    from flashy_tpu.parallel.tensor import tensor_state_sharding
+    from flashy_tpu.parallel.zero import audit_expectations
+
+    mesh = make_mesh({"data": 4, "tensor": 2})
+    cfg = TransformerConfig(vocab_size=64, dim=32, num_layers=1,
+                            num_heads=4, attention="dense",
+                            dtype=jnp.float32)
+    model = TransformerLM(cfg)  # no mesh: the defect under test
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    optim = optax.adamw(1e-3)
+    state = {"params": variables, "opt_state": optim.init(variables)}
+    declared = audit_expectations(
+        tensor_state_sharding(state, mesh, min_size=2 ** 6))
+    assert any(".mu[" in p for p in declared["expect_sharded"])
+    # params are declared sharded too (the tensor axis splits the
+    # model math, not just the update)
+    assert any(p.startswith("['params']") for p in
+               declared["expect_sharded"])
+    state = jax.device_put(state, jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P()), state))
+    tokens = jax.device_put(jnp.zeros((8, 16), jnp.int32),
+                            NamedSharding(mesh, P()))
+
+    def step(s, t):
+        def loss_fn(variables):
+            logits = model.apply(variables, t)
+            return jnp.mean(logits ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(s["params"])
+        updates, opt_state = optim.update(grads, s["opt_state"],
+                                          s["params"])
+        return {"params": optax.apply_updates(s["params"], updates),
+                "opt_state": opt_state}, {"loss": loss}
+
+    jitted = jax.jit(step)
+    compiled = jitted.lower(state, tokens).compile()
+    out_state, _ = jitted(state, tokens)
+    program = AuditProgram(
+        label="seeded/replicated-tensor", compiled=compiled,
+        state=out_state, **declared)
+    findings = audit_programs([program], select=["FT101"])
+    keys = {f.key for f in findings}
+    assert any(k.startswith("replicated-leaf:") and ".mu[" in k
+               for k in keys), keys
+    assert "per-device-bytes" in keys
+    assert any(k.startswith("missing-collective:") for k in keys), keys
