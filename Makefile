@@ -117,6 +117,19 @@ serve-paged-demo:
 serve-slo-demo:
 	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --legs slo
 
+# State-space-mixer gate on CPU: a pure-SSD stack served through
+# cache_layout='ssd', where each slot's decode state is one fixed
+# [H, Dh, Dstate] tensor — dual-form (chunked vs recurrent) parity
+# asserted at the ops layer, streaming sessions token-exact vs
+# per-request generate() PAST the engine's attention-layout
+# max_seq_len ceiling, zero post-warm-up compiles, and
+# state_bytes_per_slot constant across max_seq_len in {1k, 8k, 64k}
+# while paged-int8 grows linearly (so the same HBM budget holds
+# strictly more SSD slots at 64k context). Exit 1 on any violation.
+# Seconds; also run by the tests workflow.
+ssd-demo:
+	JAX_PLATFORMS=cpu python -m flashy_tpu.serve --legs ssd
+
 # Serving-fleet gate on CPU, all four legs: disaggregated prefill->
 # decode handoff over one shared block pool (block-list transfer,
 # token-exact vs per-request generate(), zero post-warm-up compiles on
@@ -231,4 +244,4 @@ native:
 dist:
 	python -m build --sdist
 
-.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo serve-slo-demo fleet-demo chaos-demo chaos-campaign elastic-demo zero-demo pipeline-demo tp-demo datapipe-demo docs native dist
+.PHONY: default linter tests tests-all analyze analyze-trace analyze-numerics analyze-all coverage bench serve-demo serve-spec-demo serve-paged-demo serve-slo-demo ssd-demo fleet-demo chaos-demo chaos-campaign elastic-demo zero-demo pipeline-demo tp-demo datapipe-demo docs native dist

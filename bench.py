@@ -84,14 +84,15 @@ DETAIL_PATH = os.path.join(_STATE_DIR, "BENCH_DETAIL.json")
 # Budget for the single stdout JSON line: the driver records only a
 # ~2,000-char tail of stdout, so the line must stay comfortably inside
 # it (r3's multi-KB line made BENCH_r03.json parse as null).
-# 1850 still clears the ~2,000-char driver tail (plus the ~100-char
+# 1900 still clears the ~2,000-char driver tail (plus the ~100-char
 # metric prefix); raised from 1500 when the pipeline leg became the
 # 13th compact entry, from 1600 when it grew the three
 # packed-schedule aliases, from 1700 when the roofline leg became the
-# 14th compact entry, and from 1800 when the recovery leg became the
-# 16th (worst case measured 1812 by
+# 14th compact entry, from 1800 when the recovery leg became the
+# 16th, and from 1850 when the decode leg grew the ssd subleg's three
+# capacity scalars (worst case measured 1887 by
 # test_compact_line_fits_driver_tail_worst_case).
-MAX_LINE_CHARS = 1850
+MAX_LINE_CHARS = 1900
 
 # Peak bf16 matmul FLOP/s per chip, by device_kind substring (public
 # cloud.google.com/tpu/docs numbers).
@@ -1046,6 +1047,86 @@ def bench_decode(jax, on_tpu: bool):
     except Exception as exc:  # noqa: BLE001  (serve leg is additive)
         log(f"decode paged sub-leg skipped: {exc}")
         result["paged_error"] = str(exc)[:200]
+
+    # --- SSD mixer: the constant-memory decode state. Same flagship
+    # geometry with every mixer a state-space layer, served through
+    # cache_layout='ssd' — tok/s at equal batch rides along, but the
+    # story this subleg records is capacity: state bytes per slot is
+    # INDEPENDENT of max_seq_len (one [H, Dh, Dstate] f32 tensor per
+    # layer), so at the dense layout's HBM budget the slot count beats
+    # the paged-int8 baseline and keeps growing with context length
+    # while paged's shrinks.
+    try:
+        from flashy_tpu.serve import (ContinuousBatchingScheduler,
+                                      DecodeEngine)
+        from flashy_tpu.serve.engine import state_bytes_per_slot
+
+        slots = batch
+        block_size = 16 if on_tpu else 8
+        ssd_cfg = TransformerConfig(
+            vocab_size=vocab, dim=dim, num_layers=layers,
+            num_heads=heads, attention="dense",
+            max_seq_len=cfg.max_seq_len, dtype=cfg.dtype,
+            mixer="ssd", ssd_state_dim=16)
+        ssd_model = TransformerLM(ssd_cfg)
+        ssd_params = {"params": ssd_model.init(
+            jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))["params"]}
+        corpus_rng = np.random.default_rng(13)
+        ssd_new = cfg.max_seq_len - 16
+        ssd_workload = [
+            (corpus_rng.integers(0, vocab, 8).astype(np.int32), ssd_new)
+            for _ in range(slots)]
+
+        engine = DecodeEngine(ssd_model, ssd_params, slots=slots,
+                              max_seq_len=cfg.max_seq_len,
+                              cache_layout="ssd", cache_scope="bench_ssd")
+        engine.warmup(prompt_lengths=[len(p) for p, _ in ssd_workload])
+        scheduler = ContinuousBatchingScheduler(
+            engine, max_queue=len(ssd_workload))
+        best = 0.0
+        for _ in range(3):  # best-of-3 synchronized decode waves
+            handles = [scheduler.submit(p, m) for p, m in ssd_workload]
+            while any(h.state in ("queued", "prefilling")
+                      for h in handles):
+                scheduler.step()
+            decoded = sum(len(h.generated) for h in handles)
+            begin = time.perf_counter()
+            scheduler.run()
+            wall = time.perf_counter() - begin
+            tokens = sum(len(h.generated) for h in handles) - decoded
+            best = max(best, tokens / wall)
+        assert engine.compile_cache.stats()["recompiles"] == 0
+        ssd_tok_s = best / len(jax.devices())
+
+        # capacity at the dense layout's HBM budget for `slots` slots
+        # of this geometry, against the paged-int8 row above: paged
+        # reserves max_seq_len/block_size blocks per slot (grows with
+        # context), ssd reserves one fixed state per layer
+        ssd_per_slot = engine.state_bytes_per_slot()
+        budget = slots * state_bytes_per_slot(cfg, cfg.max_seq_len,
+                                              "dense")
+        paged_per_slot = state_bytes_per_slot(
+            cfg, cfg.max_seq_len, "paged", kv_dtype="int8",
+            block_size=block_size)
+        result.update({
+            "ssd_tokens_per_sec_per_chip": round(ssd_tok_s, 1),
+            "ssd_state_bytes_per_slot": int(ssd_per_slot),
+            "ssd_max_concurrent_slots_at_fixed_hbm": int(
+                budget // ssd_per_slot),
+            "ssd_paged_slots_at_same_budget": int(
+                budget // paged_per_slot),
+            "ssd_state_dim": int(ssd_cfg.ssd_state_dim),
+        })
+        log(f"decode ssd: {ssd_tok_s:.0f} tok/s/chip at equal batch, "
+            f"{ssd_per_slot / 1024:.1f} KiB/slot decode state "
+            f"(context-independent) -> "
+            f"{result['ssd_max_concurrent_slots_at_fixed_hbm']} slots "
+            f"at the dense {slots}-slot budget vs "
+            f"{result['ssd_paged_slots_at_same_budget']} paged-int8 "
+            f"at context {cfg.max_seq_len}")
+    except Exception as exc:  # noqa: BLE001  (subleg is additive)
+        log(f"decode ssd sub-leg skipped: {exc}")
+        result["ssd_error"] = str(exc)[:200]
     return result
 
 
@@ -1814,7 +1895,9 @@ _COMPACT_KEYS = {
                "paged_tokens_per_sec_per_chip", "paged_vs_dense",
                "kv_bytes_per_slot", "max_concurrent_slots_at_fixed_hbm",
                "prefix_hit_rate", "fused_tokens_per_sec_per_chip",
-               "fused_vs_gather", "kv_read_bytes_per_token"),
+               "fused_vs_gather", "kv_read_bytes_per_token",
+               "ssd_tokens_per_sec_per_chip", "ssd_state_bytes_per_slot",
+               "ssd_max_concurrent_slots_at_fixed_hbm"),
     "fleet": ("tokens_per_sec_per_chip", "scaling_2e", "scaling_4e",
               "shed_rate", "ttft_ms_p95"),
     "recovery": ("wal_replay_ms", "recovery_drain_ms",

@@ -17,7 +17,8 @@ __all__ = ["demo_programs", "SWEEP_LEGS"]
 # leg -> registry; each registry returns NumericsProgram kwargs dicts
 # whose labels are prefixed `leg/...` (the sweep asserts that, so a
 # registry cannot silently contribute to the wrong leg)
-SWEEP_LEGS = ("train", "pipeline", "attention", "serve", "datapipe")
+SWEEP_LEGS = ("train", "pipeline", "attention", "serve", "ssd",
+              "datapipe")
 
 
 def _require_devices(minimum: int) -> None:
@@ -39,7 +40,7 @@ def _registry_entries(legs: tp.Sequence[str]
     if "train" in legs or "pipeline" in legs:
         from ...parallel.audit import numerics_audit_programs
         entries += numerics_audit_programs()
-    if "attention" in legs or "serve" in legs:
+    if "attention" in legs or "serve" in legs or "ssd" in legs:
         from ...models.audit import numerics_audit_programs
         entries += numerics_audit_programs()
     if "datapipe" in legs:

@@ -123,6 +123,11 @@ def format_serve_status(status: dict) -> str:
         if status.get("kv_dtype"):
             layout += f"/{status['kv_dtype']}"
         parts.append(f"cache={layout}")
+    if "state_bytes_per_slot" in status:
+        # decode-state bytes one slot reserves (constant in context
+        # length on the SSD layout — the printed O(1)-cache number)
+        parts.append(
+            f"state_bytes_per_slot={int(status['state_bytes_per_slot'])}")
     if "pool_occupancy_p50" in status:
         parts.append(f"pool_p50={status['pool_occupancy_p50'] * 100:.0f}%")
     if "pool_occupancy_p95" in status:

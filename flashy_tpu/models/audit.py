@@ -20,9 +20,12 @@ __all__ = ["numerics_audit_programs"]
 def numerics_audit_programs() -> tp.List[tp.Dict[str, tp.Any]]:
     """NumericsProgram kwargs for the serving-side hot programs: the
     gather-based paged int8 attention plus its fused Pallas twin and
-    the fused [S, k+1] verify read (labels `attention/...`), and the
-    [S, k+1] speculative verify forward (labels `serve/...`)."""
-    return _attention_entries() + _verify_entries()
+    the fused [S, k+1] verify read (labels `attention/...`), the
+    [S, k+1] speculative verify forward (labels `serve/...`), and the
+    SSD mixer's dual forms — chunked training scan (gather + fused
+    Pallas) and the single-token recurrent decode step (labels
+    `ssd/...`)."""
+    return _attention_entries() + _verify_entries() + _ssd_entries()
 
 
 def _attention_entries() -> tp.List[tp.Dict[str, tp.Any]]:
@@ -141,3 +144,60 @@ def _verify_entries() -> tp.List[tp.Dict[str, tp.Any]]:
         "fn": verify,
         "example_args": (params, cache, tokens, drafts, positions, key),
     }]
+
+
+def _ssd_entries() -> tp.List[tp.Dict[str, tp.Any]]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.ssd_scan import ssd_chunked_scan, ssd_recurrent_scan
+
+    batch, seq, heads, head_dim, dstate, chunk = 2, 16, 2, 8, 4, 8
+    key = jax.random.PRNGKey(0)
+    kc, kb, kv, ka = jax.random.split(key, 4)
+    c = jax.random.normal(kc, (batch, seq, heads, dstate), jnp.bfloat16)
+    b = jax.random.normal(kb, (batch, seq, heads, dstate), jnp.bfloat16)
+    v = jax.random.normal(kv, (batch, seq, heads, head_dim), jnp.bfloat16)
+    log_a = -jax.nn.softplus(
+        jax.random.normal(ka, (batch, seq, heads), jnp.float32))
+    state = jnp.zeros((batch, heads, head_dim, dstate), jnp.float32)
+
+    # the training/prefill form: bf16 activations, the inter-chunk
+    # state carried in f32 through lax.scan — FT201's carry walk must
+    # find the widened accumulator, not the bf16 inputs
+    def chunked(c_in, b_in, v_in, log_a_in, state_in):
+        return ssd_chunked_scan(c_in, b_in, v_in, log_a_in,
+                                state=state_in, chunk=chunk,
+                                kernel="gather")
+
+    def chunked_fused(c_in, b_in, v_in, log_a_in, state_in):
+        # interpret=True pins the audited program to the same jaxpr the
+        # CPU CI traces; the pallas_call eqn (and the f32 VMEM carry
+        # FT201/FT203 walk inside it) is identical on TPU
+        return ssd_chunked_scan(c_in, b_in, v_in, log_a_in,
+                                state=state_in, chunk=chunk,
+                                kernel="fused", interpret=True)
+
+    # the decode form: one token per call, the [H, Dh, Dstate] slot
+    # state advanced in f32 — the program every decode tick runs
+    def recurrent(c_in, b_in, v_in, log_a_in, state_in):
+        return ssd_recurrent_scan(c_in, b_in, v_in, log_a_in, state_in)
+
+    one = (c[:, :1], b[:, :1], v[:, :1], log_a[:, :1], state)
+    # quant_roles={} on all three: the SSD path carries no int8 K/V
+    # payloads or scales — there is no quantized contraction for FT203
+    # to place (the paged-int8-write opt-out convention)
+    return [
+        {"label": "ssd/chunked-scan",
+         "fn": chunked,
+         "example_args": (c, b, v, log_a, state),
+         "quant_roles": {}},
+        {"label": "ssd/chunked-scan-fused",
+         "fn": chunked_fused,
+         "example_args": (c, b, v, log_a, state),
+         "quant_roles": {}},
+        {"label": "ssd/recurrent-step",
+         "fn": recurrent,
+         "example_args": one,
+         "quant_roles": {}},
+    ]

@@ -20,8 +20,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .transformer import TransformerConfig, _rotary, rmsnorm as _rmsnorm
+from ..ops.ssd_scan import ssd_chunked_scan, ssd_recurrent_scan
+from .transformer import (TransformerConfig, _rotary, mixer_pattern,
+                          rmsnorm as _rmsnorm)
 from .quantize import is_quantized
+from .ssd import ssd_log_decay
 
 
 def _split_heads(qkv: jax.Array) -> tp.Tuple[jax.Array, jax.Array, jax.Array]:
@@ -50,22 +53,33 @@ def _postscale(out: jax.Array, scale) -> jax.Array:
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> tp.Dict:
-    """Allocate the static-shape KV cache.
+    """Allocate the static-shape decode cache.
 
-    Per-layer models get one {'k','v'} entry per block; scan-stacked
-    models get single stacked [L, B, T, H, Dh] arrays (the layer dim is
-    scanned together with the stacked parameters).
+    Attention layers get {'k','v'} slabs [B, max_len, H, Dh]; SSD
+    layers get one {'ssd'} f32 state [B, H, Dh, Dstate] — NO max_len
+    dim, the O(1)-in-context-length decode state. Per-layer models get
+    one entry per block; scan-stacked models (uniform mixer pattern by
+    construction) get single stacked [L, ...] arrays, the layer dim
+    scanned together with the stacked parameters. Both layouts keep the
+    slot (batch) dim at position -4 on every leaf, which is what the
+    serving engine's slot take/merge slicing relies on.
     """
     shape = (batch, max_len, cfg.num_heads, cfg.head_dim)
+    sshape = (batch, cfg.num_heads, cfg.head_dim, cfg.ssd_state_dim)
+    pattern = mixer_pattern(cfg)
     if cfg.scan_layers:
+        if pattern[0] == "ssd":
+            return {"ssd": jnp.zeros((cfg.num_layers,) + sshape,
+                                     jnp.float32)}
         stacked = (cfg.num_layers,) + shape
         return {"k": jnp.zeros(stacked, cfg.dtype),
                 "v": jnp.zeros(stacked, cfg.dtype)}
     return {
-        f"block_{i}": {
-            "k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype),
-        }
+        f"block_{i}": (
+            {"ssd": jnp.zeros(sshape, jnp.float32)}
+            if pattern[i] == "ssd" else
+            {"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)})
         for i in range(cfg.num_layers)
     }
 
@@ -214,6 +228,61 @@ def _cached_self_attention(cfg, bp: tp.Dict, x: jax.Array,
     return x + attn_out, k_cache, v_cache
 
 
+def _ssd_mixer_forward(cfg, bp: tp.Dict, x: jax.Array, state: jax.Array,
+                       token_mask: tp.Optional[jax.Array],
+                       state_mask: tp.Optional[jax.Array]):
+    """Pre-norm SSD mixer against the resident [B, H, Dh, N] f32 state.
+
+    Returns (x + mixer_out, new_state). The dual-form dispatch is by
+    shape: a single-token call (a decode tick) advances the recurrence
+    — bit-identical whether it happens in `generate()`'s token loop or
+    the serving engine's decode step — while a multi-token call (a
+    prefill slice) runs the chunked form, whose fixed-chunk tiling
+    makes any chunk-aligned partitioning of the stream bit-identical
+    to one whole-stream call (ops.ssd_scan). `token_mask` [B, S] masks
+    right-padded prefill tokens out of the state; `state_mask` [B]
+    False freezes a row's state entirely (the engine's inactive slots:
+    a mid-chunked-prefill slot must not have its accumulated state
+    advanced by decode ticks it is not part of).
+    """
+    normed = _rmsnorm(x, bp["norm1"]["scale"], cfg.dtype)
+    nstate = cfg.ssd_state_dim
+    cbv_w, cbv_s = _kernel(bp["ssd"]["cbv"]["kernel"], cfg.dtype)
+    cbv = _postscale(jnp.einsum("btd,dhp->bthp", normed, cbv_w), cbv_s)
+    c = cbv[..., :nstate]
+    b = cbv[..., nstate:2 * nstate]
+    v = cbv[..., 2 * nstate:2 * nstate + cfg.head_dim]
+    log_a = ssd_log_decay(cbv[..., -1], bp["ssd"]["dt_bias"])
+    if x.shape[1] == 1:
+        y, new_state = ssd_recurrent_scan(c, b, v, log_a, state)
+    else:
+        y, new_state = ssd_chunked_scan(
+            c, b, v, log_a, state=state,
+            chunk=cfg.ssd_chunk if cfg.ssd_chunk > 0 else None,
+            token_mask=token_mask, kernel=cfg.ssd_kernel)
+    if state_mask is not None:
+        new_state = jnp.where(state_mask[:, None, None, None], new_state,
+                              state)
+    out_w, out_s = _kernel(bp["ssd"]["out"]["kernel"], cfg.dtype)
+    out = _postscale(jnp.einsum("bthd,hdD->btD", y, out_w), out_s)
+    return x + out, new_state
+
+
+def _ssd_layer_forward(cfg: TransformerConfig, bp: tp.Dict, x: jax.Array,
+                       state: jax.Array,
+                       token_mask: tp.Optional[jax.Array] = None,
+                       state_mask: tp.Optional[jax.Array] = None):
+    """One SSD block against the resident state: returns (x, state)."""
+    x, state = _ssd_mixer_forward(cfg, bp, x, state, token_mask,
+                                  state_mask)
+    normed = _rmsnorm(x, bp["norm2"]["scale"], cfg.dtype)
+    if "moe" in bp:
+        x = x + _moe_forward(cfg, bp["moe"], normed)
+    else:
+        x = x + _gated_mlp(bp["mlp"], normed, cfg.dtype)
+    return x, state
+
+
 def _gated_mlp(bp_mlp: tp.Dict, normed: jax.Array, dtype) -> jax.Array:
     """SwiGLU MLP on pre-normed input (quantized kernels supported)."""
     up_w, up_s = _kernel(bp_mlp["up"]["kernel"], dtype)
@@ -273,37 +342,58 @@ def _head_logits(p: tp.Dict, x: jax.Array, cfg: TransformerConfig
 
 
 def _apply_step(model, params, cfg: TransformerConfig, tokens: jax.Array,
-                positions: jax.Array, cache: tp.Dict, cache_index: jax.Array):
+                positions: jax.Array, cache: tp.Dict, cache_index: jax.Array,
+                *, token_mask: tp.Optional[jax.Array] = None,
+                state_mask: tp.Optional[jax.Array] = None):
     """Forward `tokens` [B, S] at `positions`, reading+writing the cache.
 
     Re-implements the block stack against cached K/V (the training
     module computes full-sequence attention; decoding attends to the
-    cache prefix). Weights are read from the same parameter tree; the
-    scan-stacked layout runs the layer loop as a lax.scan over the
-    stacked params + stacked cache.
+    cache prefix). SSD layers — recognized by their {'ssd'} cache entry
+    — advance their resident state instead (see `_ssd_mixer_forward`;
+    `token_mask` / `state_mask` apply only to them: attention layers
+    already ignore padded/parked rows through the positions-derived
+    mask and out-of-range-dropped cache writes). Weights are read from
+    the same parameter tree; the scan-stacked layout runs the layer
+    loop as a lax.scan over the stacked params + stacked cache.
     """
     p = params["params"]
     x = _embed_tokens(p, tokens, cfg.dtype)
     if cfg.scan_layers:
         stacked = p["blocks"]["block"]  # every leaf has leading [L]
+        if "ssd" in cache:
+            def ssd_body(x, layer_in):
+                bp, s = layer_in
+                x, s = _ssd_layer_forward(cfg, bp, x, s, token_mask,
+                                          state_mask)
+                return x, s
 
-        def body(x, layer_in):
-            bp, k_c, v_c = layer_in
-            x, k_c, v_c = _layer_forward(cfg, bp, x, positions, k_c, v_c,
-                                         cache_index)
-            return x, (k_c, v_c)
+            x, states = jax.lax.scan(ssd_body, x, (stacked, cache["ssd"]))
+            new_cache: tp.Dict = {"ssd": states}
+        else:
+            def body(x, layer_in):
+                bp, k_c, v_c = layer_in
+                x, k_c, v_c = _layer_forward(cfg, bp, x, positions, k_c,
+                                             v_c, cache_index)
+                return x, (k_c, v_c)
 
-        x, (k_cache, v_cache) = jax.lax.scan(
-            body, x, (stacked, cache["k"], cache["v"]))
-        new_cache = {"k": k_cache, "v": v_cache}
+            x, (k_cache, v_cache) = jax.lax.scan(
+                body, x, (stacked, cache["k"], cache["v"]))
+            new_cache = {"k": k_cache, "v": v_cache}
     else:
         new_cache = {}
         for layer in range(cfg.num_layers):
             name = f"block_{layer}"
-            x, k_cache, v_cache = _layer_forward(
-                cfg, p[name], x, positions,
-                cache[name]["k"], cache[name]["v"], cache_index)
-            new_cache[name] = {"k": k_cache, "v": v_cache}
+            if "ssd" in cache[name]:
+                x, state = _ssd_layer_forward(
+                    cfg, p[name], x, cache[name]["ssd"], token_mask,
+                    state_mask)
+                new_cache[name] = {"ssd": state}
+            else:
+                x, k_cache, v_cache = _layer_forward(
+                    cfg, p[name], x, positions,
+                    cache[name]["k"], cache[name]["v"], cache_index)
+                new_cache[name] = {"k": k_cache, "v": v_cache}
 
     return _head_logits(p, x, cfg), new_cache
 
@@ -504,7 +594,10 @@ def generate(model, params, prompt: jax.Array, *, max_new_tokens: int,
                 "temperature=0 decoding needs no key).")
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
-    if total > cfg.max_seq_len:
+    if total > cfg.max_seq_len and "attention" in mixer_pattern(cfg):
+        # pure-SSD stacks have no length-dependent state — nothing
+        # caps T (the streaming-session story); any attention layer
+        # reinstates the ceiling.
         raise ValueError(f"prompt + new tokens {total} > max_seq_len {cfg.max_seq_len}")
     cache = init_cache(cfg, batch, total)
 

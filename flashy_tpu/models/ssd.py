@@ -1,0 +1,111 @@
+# SSD mixer — the state-space-duality layer that drops in where
+# Attention sits in a Block. One fused projection produces, per head,
+# the SSD triple plus a decay logit:
+#
+#   c [.., N]   what the output reads from the state   ("C" / query-like)
+#   b [.., N]   what the token writes into the state   ("B" / key-like)
+#   v [.., Dh]  the written value
+#   dt [.., 1]  decay logit; log a = -softplus(dt + dt_bias[h]) <= 0
+#
+# so the layer's whole sequence-mixing memory is one [H, Dh, N] f32
+# state per sequence — constant in context length, the serve-side O(1)
+# cache contract. Training/prefill run the chunked dual form
+# (ops.ssd_scan.ssd_chunked_scan: MXU matmuls inside chunks, lax.scan
+# f32 carry between them); decode advances the recurrence
+# (ssd_recurrent_scan) against the resident state. No rotary and no
+# softmax: positions enter only through the learned decays, which is
+# what lets the state stay finite-dimensional.
+#
+# Tensor-parallel story mirrors Attention: the fused cbv projection is
+# column-parallel (heads split over 'tensor', the whole scan is
+# head-local — no collective inside the mixer), the out projection is
+# row-parallel (its block-boundary `_tp_boundary` pin lowers the
+# partial sums as THE all-reduce), `transformer_shardings` maps
+# ssd/cbv and ssd/out onto the same megatron split as qkv/out.
+"""SSDMixer: linear-attention mixer with O(1) decode state."""
+import typing as tp
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.ssd_scan import SSD_LOG_RESET, ssd_chunked_scan
+
+
+def ssd_log_decay(dt: jax.Array, dt_bias: jax.Array) -> jax.Array:
+    """Decay logits [..., H] + per-head bias [H] -> log decays in
+    (-inf, 0]: `-softplus` keeps a = exp(log a) inside (0, 1), so the
+    recurrence is contractive by construction. f32 throughout — the
+    decode state this feeds is f32, and bf16 log-decays would quantize
+    the effective memory horizon."""
+    return -jax.nn.softplus(dt.astype(jnp.float32)
+                            + dt_bias.astype(jnp.float32))
+
+
+def ssd_segment_log_decay(log_a: jax.Array,
+                          segment_ids: tp.Optional[jax.Array]
+                          ) -> tp.Tuple[jax.Array,
+                                        tp.Optional[jax.Array]]:
+    """Fold packed-batch segment structure into the decays.
+
+    Returns (log_a, token_mask): at each segment START (including t=0)
+    the log decay becomes `SSD_LOG_RESET`, zeroing everything carried
+    from the previous document exactly (attention's segment mask,
+    expressed in decay space); padding tokens (segment id 0, the
+    datapipe.SequencePacker layout) get token_mask False so they
+    neither decay nor feed the state."""
+    if segment_ids is None:
+        return log_a, None
+    start = jnp.concatenate([
+        jnp.ones_like(segment_ids[:, :1], dtype=bool),
+        segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1)  # [B, T]
+    log_a = jnp.where(start[:, :, None], SSD_LOG_RESET, log_a)
+    return log_a, segment_ids > 0
+
+
+class SSDMixer(nn.Module):
+    config: tp.Any  # TransformerConfig (no import cycle: models/
+    mesh: tp.Any = None  # transformer.py imports this module)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 train: bool = False,
+                 segment_ids: tp.Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        nstate = cfg.ssd_state_dim
+        if nstate <= 0:
+            raise ValueError(
+                "config.ssd_state_dim must be > 0 for SSD mixer layers")
+        # One fused [D, H*(2N + Dh + 1)] matmul produces c, b, v and the
+        # decay logit together (the qkv-fusion idea, SSD-shaped).
+        cbv = nn.DenseGeneral(
+            (cfg.num_heads, 2 * nstate + cfg.head_dim + 1), axis=-1,
+            use_bias=False, dtype=cfg.dtype, name="cbv")(x)
+        # column-parallel output: heads split over 'tensor', the scan
+        # below is head-local — no collective here
+        from .transformer import _tp_boundary
+        cbv = _tp_boundary(cbv, self.mesh, "tensor", None)
+        c = cbv[..., :nstate]                               # [B, T, H, N]
+        b = cbv[..., nstate:2 * nstate]                     # [B, T, H, N]
+        v = cbv[..., 2 * nstate:2 * nstate + cfg.head_dim]  # [B, T, H, Dh]
+        dt = cbv[..., -1]                                   # [B, T, H]
+        # dt_bias starts the decays slow (softplus(4) ~ 4 -> a ~ 0.982):
+        # an SSD layer is only useful if its state remembers more than a
+        # few tokens at init.
+        dt_bias = self.param("dt_bias", nn.initializers.constant(-4.0),
+                             (cfg.num_heads,), jnp.float32)
+        log_a = ssd_log_decay(dt, dt_bias)
+        log_a, token_mask = ssd_segment_log_decay(log_a, segment_ids)
+
+        chunk = cfg.ssd_chunk if cfg.ssd_chunk > 0 else None
+        y, _ = ssd_chunked_scan(c, b, v, log_a, chunk=chunk,
+                                token_mask=token_mask,
+                                kernel=cfg.ssd_kernel)
+        out = nn.DenseGeneral(cfg.dim, axis=(-2, -1), use_bias=False,
+                              dtype=cfg.dtype, name="out")(y)
+        # row-parallel output: the contraction over 'tensor'-sharded
+        # heads left partial sums — this boundary IS the all-reduce
+        out = _tp_boundary(out, self.mesh)
+        if cfg.dropout > 0.0:
+            out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
+        return out

@@ -58,10 +58,37 @@ class TransformerConfig:
                                  # leading [num_layers] dim (O(1) compile
                                  # time in depth; enables 'pipe' sharding
                                  # and pipelined_apply)
+    mixer: str = "attention"     # per-layer sequence mixer: 'attention',
+                                 # 'ssd', or a comma-separated pattern
+                                 # cycled over the layers (e.g.
+                                 # 'ssd,ssd,attention' — a hybrid stack;
+                                 # see mixer_pattern)
+    ssd_state_dim: int = 16      # Dstate of SSD layers ([H, Dh, Dstate]
+                                 # decode state per sequence)
+    ssd_chunk: int = 0           # chunked-form chunk size; 0 = tuned /
+                                 # largest divisor (ops.ssd_scan)
+    ssd_kernel: str = "auto"     # 'auto' | 'gather' | 'fused' — the
+                                 # ops.ssd_scan chunked-kernel seam
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.num_heads
+
+
+def mixer_pattern(cfg: "TransformerConfig") -> tp.Tuple[str, ...]:
+    """Resolve cfg.mixer into one mixer name per layer.
+
+    A single name applies to every layer; a comma-separated pattern is
+    cycled over the depth ('ssd,attention' alternates, starting with
+    ssd). Names must be 'attention' or 'ssd'.
+    """
+    names = tuple(part.strip() for part in cfg.mixer.split(","))
+    bad = [n for n in names if n not in ("attention", "ssd")]
+    if bad:
+        raise ValueError(
+            f"config.mixer entries must be 'attention' or 'ssd', got "
+            f"{bad[0]!r} in {cfg.mixer!r}")
+    return tuple(names[i % len(names)] for i in range(cfg.num_layers))
 
 
 def rmsnorm(x: jax.Array, scale: jax.Array, dtype: tp.Any) -> jax.Array:
@@ -197,13 +224,19 @@ class MLPBlock(nn.Module):
 class Block(nn.Module):
     config: TransformerConfig
     mesh: tp.Any = None
+    mixer: str = "attention"  # this layer's entry from mixer_pattern
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
                  train: bool = False,
                  segment_ids: tp.Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
-        x = x + Attention(cfg, mesh=self.mesh, name="attn")(
+        if self.mixer == "ssd":
+            from .ssd import SSDMixer
+            mix: nn.Module = SSDMixer(cfg, mesh=self.mesh, name="ssd")
+        else:
+            mix = Attention(cfg, mesh=self.mesh, name="attn")
+        x = x + mix(
             nn.RMSNorm(dtype=cfg.dtype, name="norm1")(x), positions, train,
             segment_ids)
         normed = nn.RMSNorm(dtype=cfg.dtype, name="norm2")(x)
@@ -243,12 +276,13 @@ class _CarryBlock(nn.Module):
     config: TransformerConfig
     mesh: tp.Any = None
     train: bool = False
+    mixer: str = "attention"
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
         block = _remat(self.config) if self.config.remat else Block
-        y = block(self.config, mesh=self.mesh, name="block")(
-            x, positions, self.train, segment_ids)
+        y = block(self.config, mesh=self.mesh, mixer=self.mixer,
+                  name="block")(x, positions, self.train, segment_ids)
         return y, None
 
 
@@ -270,7 +304,11 @@ class TransformerLM(nn.Module):
         attend across their boundaries; pass the packer's per-segment
         `positions` alongside so rotary phases restart per document."""
         cfg = self.config
-        if tokens.shape[1] > cfg.max_seq_len:
+        pattern = mixer_pattern(cfg)
+        if tokens.shape[1] > cfg.max_seq_len and "attention" in pattern:
+            # A pure-SSD stack has no positional table and no [T, T]
+            # score block — nothing in it caps T, so only stacks with
+            # attention layers enforce the ceiling.
             raise ValueError(
                 f"sequence length {tokens.shape[1]} exceeds "
                 f"config.max_seq_len={cfg.max_seq_len}")
@@ -286,17 +324,28 @@ class TransformerLM(nn.Module):
         if cfg.scan_layers:
             # One compiled block body, scanned over a stacked [L, ...]
             # parameter dim — the idiomatic deep-model layout on TPU.
+            # One body means one parameter shape, so the mixer pattern
+            # must be uniform (a hybrid stack's attention and SSD
+            # layers have different parameter trees and cannot stack).
+            if len(set(pattern)) > 1:
+                raise ValueError(
+                    "scan_layers requires a uniform mixer pattern (one "
+                    f"scanned body = one parameter shape); got {pattern}. "
+                    "Use scan_layers=False for hybrid attention/SSD "
+                    "stacks.")
             scan_block = nn.scan(
                 _CarryBlock, variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=nn.broadcast,
                 length=cfg.num_layers)
             x, _ = scan_block(cfg, mesh=self.mesh, train=train,
+                              mixer=pattern[0],
                               name="blocks")(x, positions, segment_ids)
         else:
             block = _remat(cfg) if cfg.remat else Block
             for layer in range(cfg.num_layers):
-                x = block(cfg, mesh=self.mesh, name=f"block_{layer}")(
+                x = block(cfg, mesh=self.mesh, mixer=pattern[layer],
+                          name=f"block_{layer}")(
                     x, positions, train, segment_ids)
         x = nn.RMSNorm(dtype=cfg.dtype, name="norm_f")(x)
         if return_hidden:
@@ -323,6 +372,9 @@ def transformer_shardings(params: tp.Any) -> tp.Any:
       embed [V, D]            -> (tensor, fsdp)   vocab-parallel embedding
       attn qkv [D, 3, H, Dh]  -> (fsdp, None, tensor, None)  column split
       attn out [H, Dh, D]     -> (tensor, None, fsdp)        row split
+      ssd cbv [D, H, P]       -> (fsdp, tensor, None)        column split
+      ssd out [H, Dh, D]      -> (tensor, None, fsdp)        row split
+      ssd dt_bias [H]         -> (tensor,)                   head-local
       mlp up [D, 2F]          -> (fsdp, tensor)              column split
       mlp down [F, D]         -> (tensor, fsdp)              row split
       moe w_up [E, D, F]      -> (expert, fsdp, tensor)      expert parallel
@@ -349,6 +401,12 @@ def transformer_shardings(params: tp.Any) -> tp.Any:
             base = ("fsdp", None, "tensor", None)
         elif "attn/out" in joined:
             base = ("tensor", None, "fsdp")
+        elif "ssd/cbv" in joined:
+            base = ("fsdp", "tensor", None)
+        elif "ssd/out" in joined:
+            base = ("tensor", None, "fsdp")
+        elif "ssd/dt_bias" in joined:
+            base = ("tensor",)
         elif "mlp/up" in joined:
             base = ("fsdp", "tensor")
         elif "mlp/down" in joined:

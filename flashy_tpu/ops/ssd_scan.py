@@ -1,0 +1,433 @@
+# State-space-duality (SSD) chunked scan. One linear-attention layer
+# admits two provably-equivalent evaluation orders over the recurrence
+#
+#     S_t = a_t * S_{t-1} + v_t (x) b_t        y_t = S_t . c_t
+#
+# (per-head scalar decay a_t in (0, 1], state S [Dh, Dstate] per head):
+#
+#  * the CHUNKED form (training / prefill): split T into chunks of C
+#    tokens; within a chunk the pairwise decay products become a dense
+#    [C, C] mask over (c . b) scores — two MXU matmuls per chunk — and
+#    the recurrence survives only BETWEEN chunks, as a lax.scan whose
+#    carry is the f32 state (FT201: scan carries that accumulate must
+#    be f32). FLOPs stay O(T*C) instead of O(T^2), and the per-step
+#    working set is matmul-shaped, exactly what the MXU wants;
+#  * the RECURRENT form (serve/decode): advance the recurrence one
+#    token at a time against a resident [B, H, Dh, Dstate] f32 state —
+#    constant bytes per slot whatever the context length, which is the
+#    whole O(1)-cache story the serving engine builds on.
+#
+# Both forms are the same polynomial in the inputs, evaluated in a
+# different association order, so they agree to f32-accumulation
+# tolerance on the same weights (and bit-identically where the chunk
+# boundary math allows) — the dual-form parity gate tests assert it.
+#
+# All decay bookkeeping is computed as DIRECT masked sums (triangular
+# matmuls against the log-decays), never as differences of cumulative
+# sums: a segment-reset boundary sets log a_t = SSD_LOG_RESET (-1e30),
+# and `exp(L_t - L_s)` spelled as a cumsum difference would
+# catastrophically cancel the -1e30 terms into garbage, where the
+# direct sum underflows cleanly to the intended exact 0.
+#
+# The fused Pallas kernel keeps the established seam
+# (ops/paged_decode.py): kernel='auto'|'gather'|'fused', where 'gather'
+# names the XLA chunked reference (the interpret-mode bit-oracle the
+# fused kernel is tested against), an explicit 'fused' refuses to run
+# where it cannot (fused_ssd_unsupported_reason), and the tuned chunk
+# size lives in ops/tuning.py under a cache key leading "ssd_scan".
+"""SSD/linear-attention dual forms: chunked scan + recurrent step."""
+import functools
+import typing as tp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import _compat
+
+# Log-decay value that RESETS the state across a segment boundary:
+# exp(-1e30) underflows to exactly 0.0 in f32, and any masked sum
+# containing it stays ~-1e30 (f32 max is ~3.4e38, so no overflow), so
+# every decay product spanning a boundary is exactly zero.
+SSD_LOG_RESET = -1e30
+
+# Fused-kernel chunk candidates (ops/tuning.py sweeps these); the
+# default picks the largest one dividing T.
+CHUNK_CANDIDATES: tp.Tuple[int, ...] = (16, 32, 64, 128, 256)
+
+try:  # keep the module importable where pallas is absent
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_AVAILABLE = True
+except Exception:  # pragma: no cover
+    _PALLAS_AVAILABLE = False
+
+
+def fused_ssd_unsupported_reason() -> tp.Optional[str]:
+    """None when the fused chunked-scan kernel can genuinely RUN here
+    (compiled on TPU, interpret mode on CPU); else the human-readable
+    reason — the `fused_kernel_unsupported_reason` convention, so an
+    explicit kernel='fused' fails loudly instead of silently running
+    the XLA reference under a fused label."""
+    if not _PALLAS_AVAILABLE:
+        return "pallas is unavailable in this jax install"
+    backend = jax.default_backend()
+    if backend in ("gpu", "cuda", "rocm"):
+        return (f"the fused SSD kernel is TPU-targeted and the backend "
+                f"is {backend!r} (XLA's chunked path handles GPU)")
+    return None
+
+
+def default_ssd_kernel() -> str:
+    """kernel='auto' resolution: 'fused' on TPU, 'gather' (the XLA
+    chunked reference) on cpu/gpu — CPU runs opt in to the fused kernel
+    explicitly (interpret mode), the ops/paged_decode.py convention."""
+    if fused_ssd_unsupported_reason() is not None \
+            or jax.default_backend() == "cpu":
+        return "gather"
+    return "fused"
+
+
+def default_chunk(seq_len: int) -> int:
+    """Largest candidate chunk dividing `seq_len`; else the largest
+    candidate that fits (the sub-chunk tail chains exactly); else the
+    sequence itself (one chunk) — short prompts and odd tail slices
+    still evaluate in the chunked form."""
+    for cand in sorted(CHUNK_CANDIDATES, reverse=True):
+        if seq_len % cand == 0:
+            return cand
+    for cand in sorted(CHUNK_CANDIDATES, reverse=True):
+        if cand < seq_len:
+            return cand
+    return seq_len
+
+
+def _to_heads_first(x: jax.Array) -> jax.Array:
+    """[B, T, H, *] -> [B, H, T, *] (the scan-internal layout)."""
+    return jnp.swapaxes(x, 1, 2)
+
+
+def _masked_inputs(b: jax.Array, log_a: jax.Array,
+                   token_mask: tp.Optional[jax.Array]
+                   ) -> tp.Tuple[jax.Array, jax.Array]:
+    """Null out padded tokens: a masked token must neither decay the
+    state (log a := 0) nor contribute to it (b := 0 kills both its
+    score column and its outer-product write). `token_mask` is [B, T]
+    bool, True on real tokens."""
+    if token_mask is None:
+        return b, log_a
+    m = token_mask[:, :, None]
+    return (jnp.where(m[..., None], b, jnp.zeros_like(b)),
+            jnp.where(m, log_a, jnp.zeros_like(log_a)))
+
+
+def _chunk_body(c, b, v, la, state):
+    """One chunk of the chunked form, heads-first f32 decay math.
+
+    c/b: [B, H, C, N]; v: [B, H, C, Dh]; la: [B, H, C] f32 log-decays;
+    state: [B, H, Dh, N] f32 carried in. Returns (y [B, H, C, Dh] f32,
+    new_state f32). Every decay exponent is a DIRECT masked sum of la
+    (see module docstring), so segment-reset sentinels stay exact.
+    """
+    csize = la.shape[-1]
+    # seg[t, s] = sum_{r=s+1..t} la_r for t >= s (else unused): built
+    # as one triangular matmul pair, never as a cumsum difference.
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (csize, csize), 0)
+    s_idx = jax.lax.broadcasted_iota(jnp.int32, (csize, csize), 1)
+    incl_tril = (t_idx >= s_idx).astype(jnp.float32)   # r <= t
+    strict = (t_idx > s_idx).astype(jnp.float32)       # r > s
+    # contrib[r, s] = la_r when r > s
+    contrib = la[..., :, None] * strict                # [B, H, C, C]
+    seg = jnp.einsum("tr,bhrs->bhts", incl_tril, contrib,
+                     preferred_element_type=jnp.float32)
+    decay = jnp.where(t_idx >= s_idx, jnp.exp(seg), 0.0)
+    # incl[t] = sum_{r<=t} la_r ; suffix[s] = sum_{r>s} la_r ; both
+    # direct sums (no subtraction), all-negative terms -> no overflow.
+    incl = jnp.einsum("tr,bhr->bht", incl_tril, la,
+                      preferred_element_type=jnp.float32)
+    suffix = jnp.einsum("sr,bhr->bhs", strict.T, la,
+                        preferred_element_type=jnp.float32)
+    total = jnp.sum(la, axis=-1)                       # [B, H]
+
+    scores = jnp.einsum("bhtn,bhsn->bhts", c, b,
+                        preferred_element_type=jnp.float32) * decay
+    y_intra = jnp.einsum("bhts,bhsd->bhtd", scores, v.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+    y_inter = jnp.exp(incl)[..., None] * jnp.einsum(
+        "bhtn,bhdn->bhtd", c, state, preferred_element_type=jnp.float32)
+    weighted_b = b.astype(jnp.float32) * jnp.exp(suffix)[..., None]
+    new_state = jnp.exp(total)[..., None, None] * state + jnp.einsum(
+        "bhsd,bhsn->bhdn", v.astype(jnp.float32), weighted_b,
+        preferred_element_type=jnp.float32)
+    return y_intra + y_inter, new_state
+
+
+def _chunked_reference(c, b, v, la, state, chunk: int):
+    """The XLA chunked form: intra-chunk dense matmuls, inter-chunk
+    recurrence through lax.scan with the f32 state as carry."""
+    batch, heads, seq, _ = c.shape
+    n_chunks = seq // chunk
+
+    def split(x):
+        # [B, H, T, *] -> [J, B, H, C, *] (scan iterates the chunk axis)
+        parts = x.reshape(x.shape[:2] + (n_chunks, chunk) + x.shape[3:])
+        return jnp.moveaxis(parts, 2, 0)
+
+    def body(carry, xs):
+        c_j, b_j, v_j, la_j = xs
+        y_j, carry = _chunk_body(c_j, b_j, v_j, la_j, carry)
+        return carry, y_j
+
+    state, ys = jax.lax.scan(body, state, (split(c), split(b), split(v),
+                                           split(la)))
+    ys = jnp.moveaxis(ys, 0, 2)  # [J, B, H, C, Dh] -> [B, H, J, C, Dh]
+    return ys.reshape(batch, heads, seq, v.shape[-1]), state
+
+
+# ----------------------------------------------------------------------
+# fused Pallas chunked-scan kernel
+# ----------------------------------------------------------------------
+def _fused_ssd_body(c_ref, b_ref, v_ref, la_ref, s0_ref, y_ref, sout_ref,
+                    state_scr, *, chunk: int):
+    """One (batch, head, chunk) grid step.
+
+    The chunk axis iterates fastest, so for a fixed (batch, head) the
+    VMEM scratch carries the f32 state across the sequence's chunks —
+    the lax.scan carry of the reference, materialized as kernel-resident
+    scratch. Decay exponents are the same triangular matmuls as the
+    reference (direct sums only; segment-reset sentinels stay exact).
+    """
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        state_scr[:] = s0_ref[0, 0].astype(jnp.float32)
+
+    la = la_ref[0, 0].astype(jnp.float32)              # [C]
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 0)
+    s_idx = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 1)
+    incl_tril = (t_idx >= s_idx).astype(jnp.float32)
+    strict = (t_idx > s_idx).astype(jnp.float32)
+    contrib = la[:, None] * strict                     # [C, C] (= la_r at [r, s])
+    seg = jax.lax.dot(incl_tril, contrib,
+                      preferred_element_type=jnp.float32)
+    decay = jnp.where(t_idx >= s_idx, jnp.exp(seg), 0.0)
+    la_col = la[:, None]                               # [C, 1]
+    incl = jax.lax.dot(incl_tril, la_col,
+                       preferred_element_type=jnp.float32)    # [C, 1]
+    suffix = jax.lax.dot(strict.T, la_col,
+                         preferred_element_type=jnp.float32)  # [C, 1]
+    total = jnp.sum(la)
+
+    ch = c_ref[0, 0]                                   # [C, N]
+    bh = b_ref[0, 0]                                   # [C, N]
+    vh = v_ref[0, 0]                                   # [C, Dh]
+    scores = jax.lax.dot_general(                      # [C, C] f32
+        ch, bh, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * decay
+    y = jax.lax.dot(scores, vh.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    state = state_scr[:]                               # [Dh, N] f32
+    y = y + jnp.exp(incl) * jax.lax.dot_general(
+        ch.astype(jnp.float32), state, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    y_ref[0, 0] = y.astype(y_ref.dtype)
+
+    weighted_b = bh.astype(jnp.float32) * jnp.exp(suffix)
+    state_scr[:] = jnp.exp(total) * state + jax.lax.dot_general(
+        vh.astype(jnp.float32), weighted_b, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        sout_ref[0, 0] = state_scr[:]
+
+
+def _fused_call(c, b, v, la, state, *, chunk: int, interpret: bool):
+    batch, heads, seq, dstate = c.shape
+    dim = v.shape[-1]
+    n_chunks = seq // chunk
+
+    def tok_index(bi, hi, j):
+        return (bi, hi, j, 0)
+
+    def la_index(bi, hi, j):
+        return (bi, hi, j)
+
+    def state_index(bi, hi, j):
+        return (bi, hi, 0, 0)
+
+    vma = _compat.vma_of(v)
+    kernel = functools.partial(_fused_ssd_body, chunk=chunk)
+    return pl.pallas_call(
+        kernel,
+        grid=(batch, heads, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, 1, chunk, dstate), tok_index),
+            pl.BlockSpec((1, 1, chunk, dstate), tok_index),
+            pl.BlockSpec((1, 1, chunk, dim), tok_index),
+            pl.BlockSpec((1, 1, chunk), la_index),
+            pl.BlockSpec((1, 1, dim, dstate), state_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, chunk, dim), tok_index),
+            pl.BlockSpec((1, 1, dim, dstate), state_index),
+        ],
+        out_shape=[
+            _compat.shape_dtype_struct((batch, heads, seq, dim),
+                                       v.dtype, vma=vma),
+            _compat.shape_dtype_struct((batch, heads, dim, dstate),
+                                       jnp.float32, vma=vma),
+        ],
+        scratch_shapes=[pltpu.VMEM((dim, dstate), jnp.float32)],
+        interpret=interpret,
+    )(c, b, v, la, state)
+
+
+# ----------------------------------------------------------------------
+# public dual forms
+# ----------------------------------------------------------------------
+def ssd_chunked_scan(c: jax.Array, b: jax.Array, v: jax.Array,
+                     log_decay: jax.Array, *,
+                     state: tp.Optional[jax.Array] = None,
+                     chunk: tp.Optional[int] = None,
+                     token_mask: tp.Optional[jax.Array] = None,
+                     kernel: str = "gather",
+                     interpret: tp.Optional[bool] = None
+                     ) -> tp.Tuple[jax.Array, jax.Array]:
+    """The matmul-friendly CHUNKED form: [B, T] tokens -> outputs plus
+    the final state, equivalent to running the recurrence token by
+    token.
+
+    Args:
+        c: [B, T, H, Dstate] output projections (the "C" of SSD).
+        b: [B, T, H, Dstate] state input projections (the "B").
+        v: [B, T, H, Dh] values.
+        log_decay: [B, T, H] per-token log decays, <= 0 (use
+            `SSD_LOG_RESET` at segment boundaries to zero the carried
+            state exactly).
+        state: optional [B, H, Dh, Dstate] f32 carried-in state (a
+            streaming prefill's previous chunks); zeros when None.
+        chunk: intra-chunk length. Defaults to the tuned winner
+            (ops/tuning.lookup_tuned_ssd_chunk) when one is recorded,
+            else `default_chunk(T)`. T need not be a multiple: the
+            tail shorter than `chunk` is evaluated as one final chunk
+            against the carried state, which chains EXACTLY (the scan
+            carry IS the chained state) — so any partitioning of a
+            token stream at multiples of `chunk` is bit-identical to
+            one whole-stream call, the property the serving engine's
+            chunked prefill leans on for token-exactness.
+        token_mask: optional [B, T] bool, True on real tokens — padded
+            tokens neither decay nor feed the state (their outputs are
+            garbage the caller discards, the right-padding convention).
+        kernel: 'gather' = the XLA reference (and the interpret-mode
+            bit-oracle), 'fused' = the Pallas chunked-scan kernel,
+            'auto' = `default_ssd_kernel()`.
+        interpret: fused only; None resolves like `flash_attention` —
+            interpret mode on CPU, compiled on TPU, gather fallback on
+            GPU.
+
+    Returns:
+        (y [B, T, H, Dh] in v's dtype, final state [B, H, Dh, Dstate]
+        f32).
+    """
+    if kernel not in ("auto", "gather", "fused"):
+        raise ValueError(f"kernel must be 'auto', 'gather' or 'fused', "
+                         f"got {kernel!r}")
+    if kernel == "auto":
+        kernel = default_ssd_kernel()
+    batch, seq, heads, dstate = c.shape
+    dim = v.shape[-1]
+    b, log_decay = _masked_inputs(b, log_decay, token_mask)
+    if chunk is None:
+        from .tuning import lookup_tuned_ssd_chunk
+        chunk = lookup_tuned_ssd_chunk(batch, seq, heads, dim, dstate,
+                                       dtype=v.dtype)
+        if chunk is None or chunk <= 0:
+            # no winner (or a corrupt cache entry): a tuned pick must
+            # never be able to break correctness
+            chunk = default_chunk(seq)
+    elif chunk <= 0:
+        raise ValueError(f"chunk must be positive, got {chunk}")
+    chunk = min(int(chunk), seq)
+    if state is None:
+        state = jnp.zeros((batch, heads, dim, dstate), jnp.float32)
+    state = state.astype(jnp.float32)
+
+    ch = _to_heads_first(c)
+    bh = _to_heads_first(b)
+    vh = _to_heads_first(v)
+    lah = _to_heads_first(log_decay[..., None])[..., 0].astype(jnp.float32)
+
+    if kernel == "fused":
+        if not _PALLAS_AVAILABLE:
+            kernel = "gather"
+        elif interpret is None:
+            backend = jax.default_backend()
+            if backend == "cpu":
+                interpret = True
+            elif backend in ("gpu", "cuda", "rocm"):
+                kernel = "gather"
+            else:
+                interpret = False
+    def run(c_p, b_p, v_p, la_p, state_p, chunk_p):
+        if kernel == "fused":
+            return _fused_call(c_p, b_p, v_p, la_p, state_p, chunk=chunk_p,
+                               interpret=bool(interpret))
+        return _chunked_reference(c_p, b_p, v_p, la_p, state_p, chunk_p)
+
+    # Full chunks first, then the sub-chunk tail as one final chunk
+    # against the carried state — exact chaining (see `chunk` above).
+    full = (seq // chunk) * chunk
+    if full == 0:
+        y, final = run(ch, bh, vh, lah, state, seq)
+    elif full == seq:
+        y, final = run(ch, bh, vh, lah, state, chunk)
+    else:
+        cut = lambda x, lo, hi: x[:, :, lo:hi]
+        y0, mid = run(cut(ch, 0, full), cut(bh, 0, full),
+                      cut(vh, 0, full), lah[:, :, :full], state, chunk)
+        y1, final = run(cut(ch, full, seq), cut(bh, full, seq),
+                        cut(vh, full, seq), lah[:, :, full:], mid,
+                        seq - full)
+        y = jnp.concatenate([y0, y1], axis=2)
+    return _to_heads_first(y).astype(v.dtype), final
+
+
+def ssd_recurrent_scan(c: jax.Array, b: jax.Array, v: jax.Array,
+                       log_decay: jax.Array, state: jax.Array
+                       ) -> tp.Tuple[jax.Array, jax.Array]:
+    """The RECURRENT form: advance the state one token at a time.
+
+    Same argument shapes as `ssd_chunked_scan` plus the mandatory
+    [B, H, Dh, Dstate] f32 `state`; T is usually 1 (a decode step) but
+    any T runs — a lax.scan over time with the f32 state as carry (the
+    recurrent reference the dual-form parity gate compares against).
+    Returns (y [B, T, H, Dh] in v's dtype, new state f32).
+    """
+    ch = _to_heads_first(c).astype(jnp.float32)
+    bh = _to_heads_first(b).astype(jnp.float32)
+    vh = _to_heads_first(v).astype(jnp.float32)
+    lah = _to_heads_first(log_decay[..., None])[..., 0].astype(jnp.float32)
+    state = state.astype(jnp.float32)
+
+    def step(carry, xs):
+        c_t, b_t, v_t, la_t = xs          # [B, H, N/N/Dh/-]
+        carry = (jnp.exp(la_t)[..., None, None] * carry
+                 + v_t[..., :, None] * b_t[..., None, :])
+        y_t = jnp.einsum("bhdn,bhn->bhd", carry, c_t,
+                         preferred_element_type=jnp.float32)
+        return carry, y_t
+
+    to_time = lambda x: jnp.moveaxis(x, 2, 0)  # [B, H, T, *] -> [T, B, H, *]
+    state, ys = jax.lax.scan(
+        step, state, (to_time(ch), to_time(bh), to_time(vh), to_time(lah)))
+    y = jnp.moveaxis(ys, 0, 2)                 # [B, H, T, Dh]
+    return _to_heads_first(y).astype(v.dtype), state
+
+
+def ssd_state_bytes(num_heads: int, head_dim: int, dstate: int) -> int:
+    """Bytes of ONE layer's per-sequence SSD state: the [H, Dh, Dstate]
+    f32 recurrence carry — independent of context length, which is the
+    number the serving capacity math builds on."""
+    return num_heads * head_dim * dstate * 4

@@ -524,6 +524,96 @@ def tune_paged_blocks(batch: int, queries: int, heads: int,
 
 
 # ----------------------------------------------------------------------
+# fused SSD chunked-scan kernel (ops/ssd_scan.py): chunk-size tuning
+# ----------------------------------------------------------------------
+def _ssd_key(batch: int, seq: int, heads: int, head_dim: int,
+             dstate: int, dtype: tp.Any) -> tp.Tuple:
+    return _make_key("ssd_scan", batch, seq, heads, head_dim, dstate,
+                     str(jnp.dtype(dtype)))
+
+
+def lookup_tuned_ssd_chunk(batch: int, seq: int, heads: int,
+                           head_dim: int, dstate: int, *,
+                           dtype: tp.Any) -> tp.Optional[int]:
+    """Cache-only lookup of the tuned SSD chunk size — NEVER sweeps
+    (`ssd_chunked_scan` consults it at trace time, the
+    `lookup_tuned_blocks` convention). None on a miss."""
+    try:
+        key = _ssd_key(batch, seq, heads, head_dim, dstate, dtype)
+    except Exception:  # devices not initialized / no backend
+        return None
+    return _coerce_int(_lookup(key))
+
+
+def tune_ssd_chunk(batch: int, seq: int, heads: int, head_dim: int,
+                   dstate: int, *, dtype: tp.Any = jnp.bfloat16,
+                   candidates: tp.Optional[tp.Sequence[int]] = None,
+                   reps: int = 5,
+                   interpret: tp.Optional[bool] = None) -> int:
+    """Measure fused SSD chunked-scan chunk-size candidates per
+    `device_kind`; return (and persist) the winner.
+
+    The chunk size trades intra-chunk matmul shape ([C, C] decay mask,
+    [C, N]/[C, Dh] operands — bigger C feeds the MXU better) against
+    grid length and VMEM residency, so the winner is a device-kind
+    property. Candidates default to `ssd_scan.CHUNK_CANDIDATES`
+    filtered to divisors of `seq`. On CPU without explicit
+    `interpret=True` the default chunk is returned unswept —
+    interpret-mode timings are meaningless, the `tune_flash_blocks`
+    convention.
+    """
+    from .ssd_scan import (_PALLAS_AVAILABLE, CHUNK_CANDIDATES,
+                           default_chunk, ssd_chunked_scan)
+
+    key = _ssd_key(batch, seq, heads, head_dim, dstate, dtype)
+    hit = _coerce_int(_lookup(key))
+    if hit is not None:
+        return hit
+    disk_key = "/".join(str(part) for part in key)
+
+    if candidates is None:
+        candidates = CHUNK_CANDIDATES
+    viable = [c for c in candidates if c <= seq and seq % c == 0]
+    # sweep only where the fused kernel actually RUNS (the
+    # tune_paged_blocks rationale: interpret-mode or fallback timings
+    # would persist a noise winner onto shared storage).
+    backend = jax.default_backend()
+    if not viable or not _PALLAS_AVAILABLE \
+            or (not interpret
+                and backend in ("cpu", "gpu", "cuda", "rocm")):
+        return default_chunk(seq)
+
+    rng = np.random.default_rng(0)
+    c = jnp.asarray(rng.standard_normal((batch, seq, heads, dstate)), dtype)
+    b = jnp.asarray(rng.standard_normal((batch, seq, heads, dstate)), dtype)
+    v = jnp.asarray(rng.standard_normal((batch, seq, heads, head_dim)), dtype)
+    log_a = -jnp.abs(jnp.asarray(
+        rng.standard_normal((batch, seq, heads)), jnp.float32))
+
+    def build(chunk: int) -> tp.Callable[[], tp.Any]:
+        fwd = jax.jit(functools.partial(
+            ssd_chunked_scan, chunk=chunk, kernel="fused",
+            interpret=interpret))
+        return lambda: fwd(c, b, v, log_a)
+
+    timings: tp.Dict[int, float] = {}
+    for chunk in viable:
+        try:
+            timings[chunk] = _time_call(build(chunk), reps)
+        except Exception as exc:  # tile too large for VMEM, etc.
+            logger.debug("ssd tune: chunk %d failed: %s", chunk, exc)
+    if not timings:
+        return default_chunk(seq)
+    best = min(timings, key=timings.get)  # type: ignore[arg-type]
+    logger.info("ssd tune %s: best chunk %d (%.3f ms); swept %d "
+                "candidates", key, best, timings[best] * 1e3,
+                len(timings))
+    _cache[key] = best
+    _store_disk_cache(disk_key, best)
+    return best
+
+
+# ----------------------------------------------------------------------
 # inspection CLI: `python -m flashy_tpu.ops.tuning --show / --clear`
 # ----------------------------------------------------------------------
 def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:

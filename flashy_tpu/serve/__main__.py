@@ -29,6 +29,19 @@
 #                interpret mode — the same gates, proven on the kernel
 #                the TPU serves with (--kernel gather re-runs the XLA
 #                reference path).
+#  * ssd         the state-space mixer: a pure-SSD stack served with
+#                cache_layout='ssd', whose per-slot decode state is ONE
+#                fixed [H, Dh, Dstate] tensor instead of a
+#                max_seq_len-long K/V slab. Gates: the chunked
+#                (training) and recurrent (serving) forms agree on the
+#                same inputs, streaming sessions run token-exact PAST
+#                the engine's attention-layout max_seq_len ceiling vs
+#                per-request generate(), zero post-warm-up compiles,
+#                and state_bytes_per_slot stays CONSTANT across
+#                max_seq_len in {1k, 8k, 64k} while a paged-int8
+#                attention cache grows linearly — so at a fixed HBM
+#                budget the SSD engine fits strictly more concurrent
+#                slots than paged-int8 at 64k context.
 #  * slo         the observability contract: the batching workload
 #                served twice (tracing off, then RequestTracer at
 #                sampling=1.0 + SLOEngine); every finished request must
@@ -45,7 +58,7 @@ import typing as tp
 
 logger = logging.getLogger("flashy_tpu.serve.demo")
 
-LEGS = ("batching", "speculative", "chunked", "paged", "slo")
+LEGS = ("batching", "speculative", "chunked", "paged", "ssd", "slo")
 
 
 def _build_model(vocab: int, seed: int):
@@ -584,6 +597,193 @@ def run_paged_demo(requests: int = 32, dense_slots: int = 4,
     return 1 if failures else 0
 
 
+def run_ssd_demo(requests: int = 6, slots: int = 4, chunk: int = 8,
+                 ceiling: int = 64, seed: int = 0,
+                 log: tp.Optional[logging.Logger] = None) -> int:
+    """SSD mixer acceptance gate: constant-memory long-context decode.
+
+    Builds a pure-SSD TransformerLM (every mixer a state-space layer,
+    `ssd_chunk` pinned to the engine's prefill chunk so engine chunking
+    is bit-identical to generate()'s whole-prompt call) and serves
+    streaming sessions through a `cache_layout='ssd'` engine whose
+    max_seq_len is a deliberately SMALL attention-layout ceiling.
+    Exits 1 unless:
+
+      * the chunked (training) and recurrent (serving) forms agree on
+        identical inputs — the state-space duality the subsystem is
+        named for, asserted directly at the ops layer;
+      * every session streams token-exact vs per-request generate()
+        to final positions PAST the ceiling (the O(1) state makes
+        max_seq_len a prefill-chunking parameter, not a wall);
+      * admission, chunked prefill, decode and retirement trigger zero
+        post-warm-up compiles;
+      * `state_bytes_per_slot` is CONSTANT across max_seq_len in
+        {1k, 8k, 64k} while the paged-int8 attention layout grows
+        linearly, and at the 64k paged pool's HBM budget the SSD
+        layout fits strictly more concurrent slots — and the same
+        number is what `ServeMetrics.static_info` publishes to
+        serve.json.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..models import TransformerConfig, TransformerLM
+    from ..models.decoding import generate
+    from ..ops.ssd_scan import ssd_chunked_scan, ssd_recurrent_scan
+    from .engine import DecodeEngine, state_bytes_per_slot
+    from .scheduler import ContinuousBatchingScheduler
+
+    log = log or logger
+    vocab = 64
+    cfg = TransformerConfig(vocab_size=vocab, dim=32, num_layers=2,
+                            num_heads=4, attention="dense",
+                            max_seq_len=4096, dtype=jnp.float32,
+                            mixer="ssd", ssd_state_dim=8, ssd_chunk=chunk)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.ones((1, 8), jnp.int32))
+    rng = np.random.default_rng(seed + 5)
+    failures = 0
+
+    # --- gate 1: state-space duality, asserted at the ops layer. One
+    # random sequence, model-scale shapes: the chunked form (intra-chunk
+    # dense matmuls + inter-chunk f32 carry) and the recurrent form
+    # (one [H, Dh, Dstate] state advanced per token) must agree.
+    b_, t_, h_, dh_, n_ = 2, 3 * chunk + 5, cfg.num_heads, cfg.head_dim, 8
+    key = jax.random.PRNGKey(seed + 7)
+    kc, kb, kv, ka = jax.random.split(key, 4)
+    c = jax.random.normal(kc, (b_, t_, h_, n_), jnp.float32)
+    bq = jax.random.normal(kb, (b_, t_, h_, n_), jnp.float32)
+    v = jax.random.normal(kv, (b_, t_, h_, dh_), jnp.float32)
+    la = -jax.nn.softplus(jax.random.normal(ka, (b_, t_, h_), jnp.float32))
+    y_chunk, s_chunk = ssd_chunked_scan(c, bq, v, la, chunk=chunk)
+    y_rec, s_rec = ssd_recurrent_scan(c, bq, v, la,
+                                      jnp.zeros((b_, h_, dh_, n_),
+                                                jnp.float32))
+    err_y = float(jnp.max(jnp.abs(y_chunk - y_rec)))
+    err_s = float(jnp.max(jnp.abs(s_chunk - s_rec)))
+    log.info("ssd leg: dual-form parity on [%d, %d] tokens: max |dy| "
+             "%.2e, max |dstate| %.2e", b_, t_, err_y, err_s)
+    if err_y > 1e-4 or err_s > 1e-4:
+        log.error("chunked and recurrent SSD forms diverged — the "
+                  "duality the serving path depends on does not hold")
+        failures += 1
+
+    # --- gate 2+3: streaming sessions past the ceiling, token-exact,
+    # compile-free. The engine's max_seq_len is the ceiling an
+    # attention layout would enforce; pure-SSD engines are unbounded.
+    engine = DecodeEngine(model, params, slots=slots, chunk=chunk,
+                          max_seq_len=ceiling, cache_layout="ssd")
+    assert engine.unbounded, "pure-SSD engine must report unbounded"
+    log.info("ssd leg: warming %d-slot ssd engine (chunk=%d, ceiling "
+             "%d tokens, %d state bytes/slot)...", slots, chunk,
+             ceiling, engine.state_bytes_per_slot())
+    engine.warmup()
+    warm_misses = engine.compile_cache.stats()["misses"]
+
+    scheduler = ContinuousBatchingScheduler(engine)
+    published = scheduler.metrics.static_info.get("state_bytes_per_slot")
+    if published != engine.state_bytes_per_slot():
+        log.error("static_info publishes state_bytes_per_slot=%s, "
+                  "engine says %d", published,
+                  engine.state_bytes_per_slot())
+        failures += 1
+
+    # every session's final position clears the ceiling: long
+    # generations on mixed prompts, staggered admission
+    workload = []
+    for i in range(requests):
+        plen = int(rng.integers(5, 3 * chunk))
+        max_new = ceiling - plen + int(rng.integers(8, 33))
+        workload.append((rng.integers(0, vocab, plen).astype(np.int32),
+                         max_new))
+    handles = []
+    pending = list(workload)
+    while pending or not scheduler.idle:
+        room = scheduler.max_queue - scheduler.queue_depth
+        for _ in range(min(2, len(pending), room)):
+            prompt, max_new = pending.pop(0)
+            handles.append(scheduler.submit(prompt, max_new))
+        scheduler.step()
+
+    stats = engine.compile_cache.stats()
+    post_warm_builds = stats["misses"] - warm_misses
+    finals = [len(p) + n for p, n in workload]
+    log.info("ssd leg: %d sessions streamed to final positions %s "
+             "(ceiling %d); compile cache: %d executables, %d "
+             "post-warm-up builds, %d recompiles", len(handles),
+             sorted(finals), ceiling, stats["entries"],
+             post_warm_builds, stats["recompiles"])
+    if not all(h.done for h in handles):
+        log.error("%d sessions never finished",
+                  sum(not h.done for h in handles))
+        failures += 1
+    if min(finals) <= ceiling:
+        log.error("a session ended at position %d <= the %d ceiling — "
+                  "the leg did not prove streaming past it",
+                  min(finals), ceiling)
+        failures += 1
+    if stats["recompiles"] != 0 or post_warm_builds != 0:
+        log.error("ssd steady state was not compile-free: %d "
+                  "recompiles, %d post-warm-up builds",
+                  stats["recompiles"], post_warm_builds)
+        failures += 1
+    mismatches = 0
+    for handle in handles:
+        want = np.asarray(generate(model, params, handle.prompt[None],
+                                   max_new_tokens=handle.max_new_tokens))[0]
+        if not np.array_equal(handle.output, want):
+            mismatches += 1
+            log.error("session %d diverged from generate() past the "
+                      "ceiling:\n  served   %s\n  generate %s",
+                      handle.uid, handle.output.tolist(), want.tolist())
+    if mismatches:
+        failures += 1
+    else:
+        log.info("verified: all %d streaming sessions token-exact "
+                 "against per-request generate() past the %d-token "
+                 "ceiling", len(handles), ceiling)
+
+    # --- gate 4: O(1) state. Host arithmetic over the SAME accounting
+    # `static_info` publishes: SSD state bytes must not move with
+    # max_seq_len while paged-int8 attention grows linearly, and the
+    # 64k paged budget must buy MORE ssd slots than paged slots.
+    attn_cfg = TransformerConfig(vocab_size=vocab, dim=32, num_layers=2,
+                                 num_heads=4, attention="dense",
+                                 max_seq_len=65536, dtype=jnp.float32)
+    lens = (1024, 8192, 65536)
+    ssd_bytes = [state_bytes_per_slot(cfg, n, "ssd") for n in lens]
+    paged_bytes = [state_bytes_per_slot(attn_cfg, n, "paged",
+                                        kv_dtype="int8", block_size=16)
+                   for n in lens]
+    log.info("ssd leg: state bytes/slot across max_seq_len %s: ssd %s "
+             "(constant), paged-int8 %s (linear)", lens, ssd_bytes,
+             paged_bytes)
+    if len(set(ssd_bytes)) != 1:
+        log.error("ssd state bytes/slot moved with max_seq_len: %s — "
+                  "the O(1) contract is broken", ssd_bytes)
+        failures += 1
+    if not (paged_bytes[0] < paged_bytes[1] < paged_bytes[2]):
+        log.error("paged-int8 bytes/slot %s are not growing with "
+                  "max_seq_len — the comparison baseline is wrong",
+                  paged_bytes)
+        failures += 1
+    budget = 16 * paged_bytes[-1]  # 16 paged slots' worth of HBM at 64k
+    ssd_slots = budget // ssd_bytes[-1]
+    log.info("ssd leg: a %d-slot paged-int8 budget at 64k context "
+             "(%.1f MiB) holds %d ssd slots (%.0fx)", 16,
+             budget / 2**20, ssd_slots, ssd_slots / 16)
+    if ssd_slots <= 16:
+        log.error("ssd fits only %d slots in the 16-slot paged budget "
+                  "— no capacity win", ssd_slots)
+        failures += 1
+    if not failures:
+        log.info("verified: dual-form parity, token-exact streaming "
+                 "past the ceiling, compile-free steady state, O(1) "
+                 "state bytes per slot")
+    return 1 if failures else 0
+
+
 def run_slo_demo(requests: int = 24, slots: int = 8, stagger: int = 3,
                  overhead_factor: float = 2.0, seed: int = 0,
                  log: tp.Optional[logging.Logger] = None) -> int:
@@ -801,6 +1001,8 @@ def main(argv: tp.Optional[tp.Sequence[str]] = None) -> int:
                              k=args.spec_k, seed=args.seed,
                              prefix_floor=args.prefix_floor,
                              kernel=args.kernel)
+    if "ssd" in legs:
+        rc |= run_ssd_demo(chunk=args.chunk, seed=args.seed)
     if "slo" in legs:
         rc |= run_slo_demo(requests=max(8, args.requests // 2),
                            slots=args.slots, stagger=args.stagger,

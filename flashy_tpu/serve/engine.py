@@ -30,6 +30,59 @@ SPAN_VERIFY = "serve/verify"
 SPAN_ADMIT = "serve/admit"
 
 
+def _zero_ssd_leaves(cache: tp.Any, fresh: tp.Any) -> tp.Any:
+    """Zero the SSD state leaves of a cache pytree when `fresh` (a
+    traced bool scalar) is set; attention K/V leaves pass through.
+    Trace-safe: a select, never a shape change."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(path, x):
+        if any(getattr(p, "key", None) == "ssd" for p in path):
+            return jnp.where(fresh, jnp.zeros_like(x), x)
+        return x
+
+    return jax.tree_util.tree_map_with_path(leaf, cache)
+
+
+def state_bytes_per_slot(cfg: tp.Any, max_seq_len: int, cache_layout: str,
+                         *, kv_dtype: str = "model",
+                         block_size: int = 16) -> int:
+    """Decode-state bytes ONE slot reserves at `max_seq_len`, by layout.
+
+    Host arithmetic only (no allocation) — the capacity number
+    `ServeMetrics.static_info` prints and the O(1)-state gate measures:
+
+      dense:  per-layer [max_seq_len, H, Dh] K+V slabs;
+      paged:  the slot's full block budget (max_seq_len / block_size
+              blocks) at `block_bytes` — int8 pools count payload +
+              scales, exactly what admission reserves;
+      ssd:    SSD layers contribute the fixed [H, Dh, Dstate] f32
+              state — NO max_seq_len term, the O(1) contract — while
+              any attention layers in a hybrid stack keep their dense
+              slabs (hybrid cache accounting: the sum is dominated by
+              whichever layers still scale with context).
+    """
+    import jax.numpy as jnp
+    from ..models.transformer import mixer_pattern
+    pattern = mixer_pattern(cfg)
+    act_itemsize = jnp.dtype(cfg.dtype).itemsize
+    kv_slab = 2 * max_seq_len * cfg.num_heads * cfg.head_dim * act_itemsize
+    ssd_state = cfg.num_heads * cfg.head_dim * cfg.ssd_state_dim * 4
+    if cache_layout == "dense":
+        return kv_slab * cfg.num_layers
+    if cache_layout == "paged":
+        from ..ops.paged_attention import block_bytes
+        if max_seq_len % block_size:
+            raise ValueError(f"block_size {block_size} must divide "
+                             f"max_seq_len {max_seq_len}")
+        return (max_seq_len // block_size) * block_bytes(cfg, block_size,
+                                                         kv_dtype)
+    if cache_layout == "ssd":
+        return sum(ssd_state if m == "ssd" else kv_slab for m in pattern)
+    raise ValueError(f"unknown cache_layout {cache_layout!r}")
+
+
 class SlotAllocator:
     """Free-list over the S cache slots.
 
@@ -134,7 +187,16 @@ class DecodeEngine:
             partially shared blocks), and the same ONE-executable-per-
             shape discipline holds — tables and liveness are inputs,
             never shapes. Paged engines always prefill in chunks
-            (`chunk` defaults to `block_size`).
+            (`chunk` defaults to `block_size`). 'ssd' is REQUIRED (and
+            only valid) when the model's mixer pattern contains SSD
+            layers: each such layer's slot state is one resident
+            [H, Dh, Dstate] f32 tensor in the pooled cache — constant
+            bytes per slot whatever the context length — prefill runs
+            the chunked dual form and carries the emitted state, decode
+            advances the recurrence. Hybrid stacks keep their attention
+            layers' dense [S, max_seq_len] slabs beside the SSD states
+            in the same cache pytree; a PURE-SSD stack sets
+            `self.unbounded` and may stream sessions past max_seq_len.
         block_size: tokens per pool block (paged only); must divide
             `max_seq_len`.
         num_blocks: pool size including the sentinel block (paged
@@ -221,9 +283,34 @@ class DecodeEngine:
         self.slots = slots
         self.max_seq_len = min(max_seq_len or self._cfg.max_seq_len,
                                self._cfg.max_seq_len)
-        if cache_layout not in ("dense", "paged"):
-            raise ValueError(f"cache_layout must be 'dense' or 'paged', "
-                             f"got {cache_layout!r}")
+        if cache_layout not in ("dense", "paged", "ssd"):
+            raise ValueError(f"cache_layout must be 'dense', 'paged' or "
+                             f"'ssd', got {cache_layout!r}")
+        from ..models.transformer import mixer_pattern
+        pattern = mixer_pattern(self._cfg)
+        if "ssd" in pattern and cache_layout != "ssd":
+            raise ValueError(
+                f"the model's mixer pattern {pattern} contains SSD "
+                f"layers, whose decode state is a resident per-slot "
+                f"tensor, not positioned K/V rows — serve it with "
+                f"cache_layout='ssd' (got {cache_layout!r})")
+        if cache_layout == "ssd":
+            if "ssd" not in pattern:
+                raise ValueError(
+                    "cache_layout='ssd' needs at least one SSD layer in "
+                    f"the model's mixer pattern, got {pattern}")
+            if spec_k is not None:
+                raise ValueError(
+                    "speculative decoding is not supported with SSD "
+                    "layers: the recurrence state is cumulative, so "
+                    "rejected draft tokens cannot be rolled back for "
+                    "free the way position-indexed K/V rows can")
+        # A pure-SSD stack has NO per-slot tensor that grows with
+        # context, so sessions may stream past max_seq_len (which then
+        # only sizes prefill chunking); one attention layer's dense
+        # slab reinstates the ceiling.
+        self.unbounded = (cache_layout == "ssd"
+                          and "attention" not in pattern)
         if kv_dtype not in ("model", "int8"):
             raise ValueError(f"kv_dtype must be 'model' or 'int8', "
                              f"got {kv_dtype!r}")
@@ -480,10 +567,15 @@ class DecodeEngine:
 
         def decode(params, cache, tokens, positions, active, key):
             # tokens/positions/active: [S]; ONE executable for any mix
-            # of live slots — liveness is data, not shape.
+            # of live slots — liveness is data, not shape. `active`
+            # doubles as the SSD state gate: an inactive slot (free, or
+            # mid-chunked-prefill with accumulated state) must not have
+            # its recurrence advanced by decode ticks it is not part of
+            # (attention rows get the same protection from the parked
+            # position's dropped writes).
             logits, cache = _apply_step(
                 model, params, cfg, tokens[:, None], positions[:, None],
-                cache, positions)
+                cache, positions, state_mask=active)
             nxt = self._sample(logits[:, -1], key)
             return jnp.where(active, nxt, jnp.int32(pad)), cache
 
@@ -499,11 +591,15 @@ class DecodeEngine:
             # prompt: [1, bucket] right-padded; length/slot: scalars.
             # Pad positions >= length are never attended (causal mask)
             # and their K/V rows are overwritten by decode writes before
-            # any query can reach them, so right-padding is exact.
+            # any query can reach them, so right-padding is exact. SSD
+            # layers have no positions to hide behind — the token mask
+            # keeps pad tokens out of the accumulated state instead.
             mini = init_cache(cfg, 1, bucket)
             positions = jnp.arange(bucket, dtype=jnp.int32)[None]
+            mask = (jnp.arange(bucket, dtype=jnp.int32) < length)[None]
             logits, mini = _apply_step(model, params, cfg, prompt,
-                                       positions, mini, jnp.int32(0))
+                                       positions, mini, jnp.int32(0),
+                                       token_mask=mask)
             last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
                                                 axis=0, keepdims=True)
             first = self._sample(last, key)[0]
@@ -568,9 +664,18 @@ class DecodeEngine:
                     big, small.astype(big.dtype), starts)
 
             mini = jax.tree_util.tree_map(take, cache)
+            # A chunk at start == 0 begins a FRESH request: whatever SSD
+            # state the slot's previous occupant accumulated is zeroed
+            # here, inside the same executable (a scalar-input select —
+            # no extra reset shape to compile). Later chunks chain the
+            # carried state exactly. Attention leaves need no reset:
+            # their stale rows sit past every causal horizon.
+            mini = _zero_ssd_leaves(mini, start == 0)
             positions = (start + jnp.arange(size, dtype=jnp.int32))[None]
+            mask = (jnp.arange(size, dtype=jnp.int32) < used)[None]
             logits, mini = _apply_step(model, params, cfg, tokens,
-                                       positions, mini, start)
+                                       positions, mini, start,
+                                       token_mask=mask)
             last = jax.lax.dynamic_index_in_dim(logits[0], used - 1,
                                                 axis=0, keepdims=True)
             first = self._sample(last, key)[0]
@@ -836,6 +941,13 @@ class DecodeEngine:
             else 0.0)
         return stats
 
+    def state_bytes_per_slot(self) -> int:
+        """Decode-state bytes one slot of THIS engine reserves at its
+        max_seq_len (see module-level `state_bytes_per_slot`)."""
+        return state_bytes_per_slot(
+            self._cfg, self.max_seq_len, self.cache_layout,
+            kv_dtype=self.kv_dtype, block_size=self.block_size)
+
     def cache_bytes(self) -> int:
         """Total HBM bytes this engine's KV cache occupies (the fixed
         budget the paged-vs-dense capacity comparison holds constant)."""
@@ -909,7 +1021,7 @@ class DecodeEngine:
         if slot not in self.allocator.live:
             raise ValueError(f"slot {slot} was not acquired")
         length = int(prompt.size)
-        if length > self.max_seq_len:
+        if length > self.max_seq_len and not self.unbounded:
             raise ValueError(f"prompt length {length} exceeds "
                              f"max_seq_len {self.max_seq_len}")
         if not 0 <= start < length:
@@ -988,6 +1100,11 @@ class DecodeEngine:
         are past every causal horizon until overwritten.
         """
         import jax.numpy as jnp
+        if self.cache_layout == "ssd":
+            raise ValueError(
+                "speculative decoding is not supported on the SSD "
+                "layout: the recurrence state is cumulative, so "
+                "rejected drafts cannot be rolled back for free")
         drafts = np.asarray(drafts, np.int32)
         if drafts.ndim != 2 or drafts.shape[0] != self.slots \
                 or drafts.shape[1] < 1:
@@ -1022,7 +1139,8 @@ class DecodeEngine:
         """
         if slot not in self.allocator.live:
             raise ValueError(f"slot {slot} is not live")
-        if not 0 <= position <= self.max_seq_len:
+        if not (0 <= position <= self.max_seq_len or
+                (self.unbounded and position >= 0)):
             raise ValueError(f"position {position} outside "
                              f"[0, {self.max_seq_len}]")
         self._tokens = self._tokens.at[slot].set(int(last_token))

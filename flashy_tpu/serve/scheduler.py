@@ -164,6 +164,11 @@ class ContinuousBatchingScheduler:
         self.metrics.static_info.setdefault("cache_layout",
                                             engine.cache_layout)
         self.metrics.static_info.setdefault("kv_dtype", engine.kv_dtype)
+        # capacity math as a printed number: decode-state bytes one slot
+        # reserves under this engine's layout (constant in max_seq_len
+        # on the SSD layout — the O(1)-cache contract made observable)
+        self.metrics.static_info.setdefault("state_bytes_per_slot",
+                                            engine.state_bytes_per_slot())
         self.draft = draft
         if draft is not None and engine.spec_k is not None \
                 and draft.k != engine.spec_k:
@@ -235,16 +240,24 @@ class ContinuousBatchingScheduler:
             raise ValueError(f"prompt must be 1-D non-empty, got {prompt.shape}")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        largest_bucket = self.engine.bucket_for(self.engine.max_seq_len)
-        if prompt.size > largest_bucket:
-            raise ValueError(
-                f"prompt length {prompt.size} exceeds the largest prefill "
-                f"bucket ({largest_bucket}); it can never be prefilled")
-        total = prompt.size + max_new_tokens
-        if total > self.engine.max_seq_len:
-            raise ValueError(
-                f"prompt + max_new_tokens = {total} exceeds the engine's "
-                f"max_seq_len {self.engine.max_seq_len}")
+        unbounded = getattr(self.engine, "unbounded", False)
+        if not unbounded or self.engine.chunk is None:
+            # bucketed prefill caps prompts at the largest bucket even
+            # on an unbounded engine (the bucket IS the compiled shape)
+            largest_bucket = self.engine.bucket_for(self.engine.max_seq_len)
+            if prompt.size > largest_bucket:
+                raise ValueError(
+                    f"prompt length {prompt.size} exceeds the largest "
+                    f"prefill bucket ({largest_bucket}); it can never be "
+                    f"prefilled")
+        if not unbounded:
+            # an unbounded (pure-SSD) engine has no per-slot tensor
+            # that grows with context — no length ceiling to enforce
+            total = prompt.size + max_new_tokens
+            if total > self.engine.max_seq_len:
+                raise ValueError(
+                    f"prompt + max_new_tokens = {total} exceeds the "
+                    f"engine's max_seq_len {self.engine.max_seq_len}")
         if ttl is not None and ttl <= 0:
             raise ValueError(f"ttl must be positive (seconds), got {ttl}")
         if len(self._queue) >= self.max_queue:

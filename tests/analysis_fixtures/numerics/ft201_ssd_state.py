@@ -1,0 +1,56 @@
+# A seeded SSD decode-state bug, shape-faithful to models/ssd.py: a
+# "memory-saving" rewrite keeps the recurrent [H, Dh, Dstate] slot
+# state in the activation dtype (bf16) and advances it in delta/EMA
+# form — S_{t} = S_{t-1} + (v_t (x) b_t + (a_t - 1) S_{t-1}) — so the
+# per-token outer-product update is ADDED into a bf16 carry. Each
+# addend loses its low mantissa bits against the growing state; over a
+# long session the decode form drifts from the chunked training form
+# and the dual-form parity gate breaks. The live scan keeps its carry
+# in f32 (and updates mul-first: a*S + outer); FT201 must flag this
+# resurrection's bf16 add-accumulator without flagging the live one.
+"""Seeded FT201 violation: bf16 delta-form SSD state carry."""
+import jax
+import jax.numpy as jnp
+
+EXPECT = {
+    "fixtures/ft201-ssd-state": {("FT201", "narrow-accum:")},
+}
+
+
+def broken_ssd_decode(c, b, v, log_a):
+    """The recurrent serving form with the state held in bf16 and
+    advanced by delta addition instead of the f32 mul-first update."""
+    batch, _, heads, dstate = b.shape
+    head_dim = v.shape[-1]
+    # THE BUG: the slot state in the activations' own dtype — bf16
+    # in, bf16 accumulated, token after token
+    state0 = jnp.zeros((batch, heads, head_dim, dstate), v.dtype)
+
+    def step(state, inputs):
+        c_t, b_t, v_t, la_t = inputs
+        a_t = jnp.exp(la_t)[..., None, None]
+        outer = v_t[..., :, None] * b_t[..., None, :]
+        state = state + (outer + (a_t - 1.0) * state)
+        y_t = jnp.einsum("bhdn,bhn->bhd", state, c_t)
+        return state, y_t
+
+    swap = lambda x: jnp.swapaxes(x, 0, 1)
+    state, y = jax.lax.scan(
+        step, state0, (swap(c), swap(b), swap(v), swap(log_a)))
+    return swap(y), state
+
+
+def programs():
+    batch, seq, heads, head_dim, dstate = 2, 16, 2, 8, 4
+    key = jax.random.PRNGKey(0)
+    kc, kb, kv, ka = jax.random.split(key, 4)
+    c = jax.random.normal(kc, (batch, seq, heads, dstate), jnp.bfloat16)
+    b = jax.random.normal(kb, (batch, seq, heads, dstate), jnp.bfloat16)
+    v = jax.random.normal(kv, (batch, seq, heads, head_dim), jnp.bfloat16)
+    log_a = -jax.nn.softplus(
+        jax.random.normal(ka, (batch, seq, heads), jnp.bfloat16))
+    return [{
+        "label": "fixtures/ft201-ssd-state",
+        "fn": broken_ssd_decode,
+        "example_args": (c, b, v, log_a),
+    }]

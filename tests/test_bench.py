@@ -183,13 +183,16 @@ def test_compact_line_fits_driver_tail_worst_case():
         "bubble_frac_1f1b_int2": 0.157895, "stash_flat_in_m": True,
         "recompiles": 0, "packed_step_ratio": 0.5717,
         "packed_tick_eff": 0.8984, "packed_bitwise": True,
-        # the decode sub-leg scalars (spec/paged/fused) and the
+        # the decode sub-leg scalars (spec/paged/fused/ssd) and the
         # recovery scalars (wal_replay_ms & co) are deliberately NOT
         # in this maximal leg: they only ever appear in their one
         # entry (never once per leg), and the runtime shed guard
         # keeps any real overflow inside MAX_LINE_CHARS by trimming
         # detail — the convention since the spec/paged sublegs landed.
+        # The widest decode-only keys still ride along as
+        # representatives so each subleg's longest key IS priced once:
         "fused_vs_gather": 12.345,
+        "ssd_max_concurrent_slots_at_fixed_hbm": 12345678,
         # the lm tensor-parallel subleg scalars at maximal width, plus
         # the pipeline leg's 3D-composition flag — every key
         # _COMPACT_KEYS whitelists must be priced into the budget

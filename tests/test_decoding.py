@@ -83,10 +83,16 @@ def test_greedy_generate_scan_stacked_matches_naive():
 
 def _moe_model(scan_layers=False):
     # capacity_factor high enough that the training dispatch never drops
-    # a token, so the (dropless) decode path agrees exactly.
+    # a token, so the (dropless) decode path agrees exactly. f32, not
+    # the bf16 default: this random-init model's top-2-gated logits
+    # carry near-ties below bf16's ~2^-8 step, and CPU-emulated bf16
+    # rounds the [B, T] training forward and the [B, 1] cached step
+    # differently at equal math — the argmax comparison needs logits
+    # whose margins dominate shape-dependent rounding, which f32's
+    # 2^-24 step restores.
     cfg = TransformerConfig(vocab_size=64, dim=32, num_layers=2, num_heads=4,
                             attention="dense", max_seq_len=64,
-                            moe_experts=4, moe_top_k=2,
+                            moe_experts=4, moe_top_k=2, dtype=jnp.float32,
                             moe_capacity_factor=8.0, scan_layers=scan_layers)
     model = TransformerLM(cfg)
     params = model.init(jax.random.PRNGKey(2), jnp.ones((1, 8), jnp.int32))

@@ -103,6 +103,35 @@ def test_ft201_fixed_live_accumulation_is_clean():
     assert audit_programs([program], select=["FT201"]) == []
 
 
+def test_ft201_flags_seeded_bf16_ssd_state_carry():
+    # the delta-form resurrection: slot state kept in bf16 and advanced
+    # by ADDING the per-token update into the scan carry — the
+    # accumulator walk must find the narrow carry behind the add
+    findings, expect = _audit_fixture("ft201_ssd_state")
+    _assert_expect(findings, expect)
+    assert all(f.code == "FT201" for f in findings)
+
+
+def test_ft201_live_ssd_scan_is_clean():
+    # the SAME shapes through the repo's real SSD scan: bf16
+    # activations, but the state carried in f32 and updated mul-first
+    # (a*S + outer) — the resurrection must not fire on the fix
+    from flashy_tpu.ops.ssd_scan import ssd_chunked_scan
+
+    key = jax.random.PRNGKey(0)
+    kc, kb, kv, ka = jax.random.split(key, 4)
+    c = jax.random.normal(kc, (2, 16, 2, 4), jnp.bfloat16)
+    b = jax.random.normal(kb, (2, 16, 2, 4), jnp.bfloat16)
+    v = jax.random.normal(kv, (2, 16, 2, 8), jnp.bfloat16)
+    log_a = -jax.nn.softplus(jax.random.normal(ka, (2, 16, 2),
+                                               jnp.float32))
+    program = NumericsProgram(
+        label="live/ssd-chunked-scan",
+        fn=lambda *args: ssd_chunked_scan(*args, chunk=8),
+        example_args=(c, b, v, log_a))
+    assert audit_programs([program], select=["FT201"]) == []
+
+
 def test_ft201_narrow_reduction_operand():
     # NB jnp.sum upcasts narrow operands to f32 by itself (even with
     # dtype=bf16 it reduces in f32 and converts the result) — narrow
@@ -473,6 +502,15 @@ def test_sweep_attention_leg_labels():
                       "attention/paged-int8-fused",
                       "attention/paged-int8-fused-verify",
                       "attention/paged-int8-write"}
+    assert audit_programs(programs) == []
+
+
+def test_sweep_ssd_leg_labels():
+    programs = demo_programs(legs=("ssd",))
+    labels = {p.label for p in programs}
+    assert labels == {"ssd/chunked-scan",
+                      "ssd/chunked-scan-fused",
+                      "ssd/recurrent-step"}
     assert audit_programs(programs) == []
 
 
